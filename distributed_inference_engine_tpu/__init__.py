@@ -1,0 +1,36 @@
+"""distributed_inference_engine_tpu — a TPU-native distributed LLM serving framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``Real-VeerSandhu/Distributed-Inference-Engine`` (reference mounted at
+``/root/reference``): coordinator/worker serving with a model registry
+(versions, shards, consistent-hash routing), a router with health checks and
+deterministic failover, a strategy-based load balancer, a size/latency-triggered
+request batcher, and a response cache with LRU/LFU/FIFO eviction — with the
+reference's mock inference core (``src/mock_models/fake_model.py``) replaced by
+a real XLA engine: jit-compiled prefill/decode over a ``jax.sharding.Mesh``,
+an HBM-resident KV cache, and host-side asyncio orchestration.
+
+Layer map (heir of SURVEY.md §1):
+
+    api/        coordinator front-end + client        (the reference's missing coordinator.py)
+    cluster/    registry, router, load balancer, RPC  (reference L1+L4: model_registry/router/load_balancer)
+    serving/    batcher, response cache               (reference L3+L2: batcher.py, kvstore.py)
+    engine/     jit prefill/decode, KV cache, sched   (replaces reference L2 mock_models/)
+    models/     GPT-2 / Llama model families + fake   (no reference counterpart; BASELINE.json configs)
+    ops/        attention, sampling, pallas kernels   (TPU compute path)
+    parallel/   mesh, shardings, ring attention       (reference §2.3 parallelism, re-done as jax.sharding)
+    utils/      framing, tracing, logging             (the README-promised utils.py, done properly)
+"""
+
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    ModelConfig,
+    MeshConfig,
+    EngineConfig,
+    BatcherConfig,
+    CacheConfig,
+    HealthConfig,
+    load_config,
+)
+from .serving.cache import ResponseCache, KVStore, create_kv_store  # noqa: F401

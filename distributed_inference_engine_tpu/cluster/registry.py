@@ -1,0 +1,310 @@
+"""Model registry: metadata, versioning, shard placement, consistent hashing.
+
+Capability heir of the reference's ``src/model_registry.py``: model
+registration/versioning (``:86-114``), shard placement records (``:29-46``),
+``get_shard_for_key`` consistent hashing — md5(key) mod n_shards — so a given
+request key always lands on the same shard (``:149-161``), per-worker model
+tracking (``:175-177``), metadata-hash change detection (``:179-190``), and
+full dict round-trip serialization (``:192-249``).
+
+TPU reinterpretation (BASELINE.json north star): a *shard* is no longer "a
+worker holding a copy of the weights" — it is a **mesh placement record**: the
+worker host plus the slice of the ``jax.sharding.Mesh`` (axis sizes, spec
+name) the model partition occupies. ``get_shard_for_key`` then implements
+session/prefix-cache affinity across TPU workers, while the tensor-level
+partitioning inside one worker is carried by ``mesh_axes``.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..config import ModelConfig
+
+
+class ModelStatus(str, enum.Enum):
+    """Reference ``src/model_registry.py:20-26``."""
+
+    PENDING = "pending"
+    LOADING = "loading"
+    READY = "ready"
+    FAILED = "failed"
+    UNLOADING = "unloading"
+
+
+@dataclass
+class ModelShard:
+    """One placement of (part of) a model version on a worker
+    (reference ``src/model_registry.py:29-46``), extended with TPU mesh
+    placement."""
+
+    shard_id: int
+    worker_id: str
+    status: ModelStatus = ModelStatus.PENDING
+    load: float = 0.0
+    mesh_axes: Dict[str, int] = field(default_factory=dict)   # e.g. {"tp": 8}
+    partition_spec: str = ""                                  # sharding recipe name
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "shard_id": self.shard_id,
+            "worker_id": self.worker_id,
+            "status": self.status.value,
+            "load": self.load,
+            "mesh_axes": dict(self.mesh_axes),
+            "partition_spec": self.partition_spec,
+            "metadata": dict(self.metadata),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelShard":
+        return cls(
+            shard_id=d["shard_id"],
+            worker_id=d["worker_id"],
+            status=ModelStatus(d.get("status", "pending")),
+            load=d.get("load", 0.0),
+            mesh_axes=d.get("mesh_axes", {}),
+            partition_spec=d.get("partition_spec", ""),
+            metadata=d.get("metadata", {}),
+        )
+
+
+@dataclass
+class ModelVersion:
+    """Reference ``src/model_registry.py:49-74``."""
+
+    name: str
+    version: str
+    config: ModelConfig
+    status: ModelStatus = ModelStatus.PENDING
+    quantized: bool = False
+    shards: List[ModelShard] = field(default_factory=list)
+    created_at: float = field(default_factory=time.time)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}:{self.version}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "config": self.config.to_dict(),
+            "status": self.status.value,
+            "quantized": self.quantized,
+            "shards": [s.to_dict() for s in self.shards],
+            "created_at": self.created_at,
+            "metadata": dict(self.metadata),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelVersion":
+        return cls(
+            name=d["name"],
+            version=d["version"],
+            config=ModelConfig.from_dict(d.get("config", {"name": d["name"]})),
+            status=ModelStatus(d.get("status", "pending")),
+            quantized=d.get("quantized", False),
+            shards=[ModelShard.from_dict(s) for s in d.get("shards", [])],
+            created_at=d.get("created_at", time.time()),
+            metadata=d.get("metadata", {}),
+        )
+
+
+def stable_key_hash(key: str) -> int:
+    """md5-based stable hash — deterministic across processes and Python
+    runs, unlike builtin ``hash`` (reference ``src/model_registry.py:149-161``
+    chose md5 for the same reason)."""
+    return int.from_bytes(hashlib.md5(key.encode("utf-8")).digest()[:8], "big")
+
+
+class ModelRegistry:
+    """Thread-safe registry of model versions and their shard placements."""
+
+    def __init__(self) -> None:
+        self._versions: Dict[str, ModelVersion] = {}     # "name:version" -> MV
+        self._worker_models: Dict[str, List[str]] = {}   # worker_id -> [version keys]
+        self._hashes: Dict[str, str] = {}                # "name:version" -> metadata hash
+        self._lock = threading.RLock()
+
+    # -------------------------------------------------------- registration
+
+    def register_model(
+        self,
+        config: ModelConfig,
+        version: Optional[str] = None,
+        quantized: Optional[bool] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> ModelVersion:
+        """Register (or update) a model version (reference ``:86-114``)."""
+        with self._lock:
+            ver = version or config.version
+            existing = self._versions.get(f"{config.name}:{ver}")
+            mv = ModelVersion(
+                name=config.name,
+                version=ver,
+                config=config,
+                quantized=config.quantized if quantized is None else quantized,
+                metadata=metadata or {},
+            )
+            if existing is not None:
+                # re-registration updates config/metadata but must not orphan
+                # live shard placements (or strand their worker-index entries)
+                mv.shards = existing.shards
+                mv.status = existing.status
+                mv.created_at = existing.created_at
+            self._versions[mv.key] = mv
+            self._update_hash(mv)
+            return mv
+
+    def add_shard(
+        self,
+        name: str,
+        version: str,
+        worker_id: str,
+        shard_id: Optional[int] = None,
+        mesh_axes: Optional[Dict[str, int]] = None,
+        partition_spec: str = "",
+        status: ModelStatus = ModelStatus.READY,
+    ) -> ModelShard:
+        """Attach a shard placement to a model version (reference ``:116-147``)."""
+        with self._lock:
+            mv = self._require(name, version)
+            sid = shard_id if shard_id is not None else len(mv.shards)
+            if any(s.shard_id == sid for s in mv.shards):
+                raise ValueError(f"shard {sid} already exists for {mv.key}")
+            shard = ModelShard(
+                shard_id=sid,
+                worker_id=worker_id,
+                status=status,
+                mesh_axes=mesh_axes or {},
+                partition_spec=partition_spec,
+            )
+            mv.shards.append(shard)
+            mv.shards.sort(key=lambda s: s.shard_id)
+            self._worker_models.setdefault(worker_id, [])
+            if mv.key not in self._worker_models[worker_id]:
+                self._worker_models[worker_id].append(mv.key)
+            if mv.status is ModelStatus.PENDING:
+                mv.status = ModelStatus.READY
+            self._update_hash(mv)
+            return shard
+
+    def remove_shard(self, name: str, version: str, shard_id: int) -> bool:
+        with self._lock:
+            mv = self._require(name, version)
+            before = len(mv.shards)
+            removed = [s for s in mv.shards if s.shard_id == shard_id]
+            mv.shards = [s for s in mv.shards if s.shard_id != shard_id]
+            for s in removed:
+                # drop this version from the worker's index only if the worker
+                # no longer serves any shard of *this version*
+                still_this_version = any(
+                    sh.worker_id == s.worker_id for sh in mv.shards
+                )
+                if not still_this_version and s.worker_id in self._worker_models:
+                    self._worker_models[s.worker_id] = [
+                        k for k in self._worker_models[s.worker_id] if k != mv.key
+                    ]
+            if len(mv.shards) != before:
+                self._update_hash(mv)
+                return True
+            return False
+
+    # ------------------------------------------------------------- lookup
+
+    def get_shard_for_key(
+        self, name: str, version: str, request_key: str
+    ) -> Optional[ModelShard]:
+        """Consistent-hash placement: same key ⇒ same shard, as long as the
+        shard set is unchanged (reference ``:149-161``)."""
+        with self._lock:
+            mv = self._versions.get(f"{name}:{version}")
+            if mv is None or not mv.shards:
+                return None
+            return mv.shards[stable_key_hash(request_key) % len(mv.shards)]
+
+    def get_model_version(self, name: str, version: str) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._versions.get(f"{name}:{version}")
+
+    def list_models(self) -> List[str]:
+        with self._lock:
+            return sorted({mv.name for mv in self._versions.values()})
+
+    def list_versions(self, name: str) -> List[str]:
+        with self._lock:
+            return sorted(
+                mv.version for mv in self._versions.values() if mv.name == name
+            )
+
+    def get_worker_models(self, worker_id: str) -> List[str]:
+        """Version keys served by a worker (reference ``:175-177``)."""
+        with self._lock:
+            return list(self._worker_models.get(worker_id, []))
+
+    def all_shards(self, name: str, version: str) -> List[ModelShard]:
+        with self._lock:
+            mv = self._versions.get(f"{name}:{version}")
+            return list(mv.shards) if mv else []
+
+    # ------------------------------------------------------ change hashing
+
+    def _update_hash(self, mv: ModelVersion) -> None:
+        """md5 over the version's metadata *excluding shard state*, so the
+        hash detects config changes, not load/health churn (reference
+        ``:179-190``)."""
+        d = mv.to_dict()
+        d.pop("shards", None)
+        d.pop("created_at", None)
+        d.pop("status", None)   # placement/health churn must not look like a config change
+        blob = json.dumps(d, sort_keys=True).encode("utf-8")
+        self._hashes[mv.key] = hashlib.md5(blob).hexdigest()
+
+    def get_model_hash(self, name: str, version: str) -> Optional[str]:
+        with self._lock:
+            return self._hashes.get(f"{name}:{version}")
+
+    # -------------------------------------------------------- serialization
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "versions": {k: mv.to_dict() for k, mv in self._versions.items()},
+                "worker_models": {k: list(v) for k, v in self._worker_models.items()},
+            }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelRegistry":
+        reg = cls()
+        for key, mvd in d.get("versions", {}).items():
+            mv = ModelVersion.from_dict(mvd)
+            reg._versions[key] = mv
+            reg._update_hash(mv)
+        reg._worker_models = {k: list(v) for k, v in d.get("worker_models", {}).items()}
+        return reg
+
+    # --------------------------------------------------------------- misc
+
+    def _require(self, name: str, version: str) -> ModelVersion:
+        mv = self._versions.get(f"{name}:{version}")
+        if mv is None:
+            raise KeyError(f"model {name}:{version} is not registered")
+        return mv
+
+    def get_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "models": len({mv.name for mv in self._versions.values()}),
+                "versions": len(self._versions),
+                "shards": sum(len(mv.shards) for mv in self._versions.values()),
+                "workers": len([w for w, ms in self._worker_models.items() if ms]),
+            }

@@ -1,0 +1,188 @@
+"""Configuration tree for the framework.
+
+Heir of the reference's ``src/config.py:12-20`` (a single ``ModelConfig``
+dataclass) plus every constructor-knob cluster scattered through the reference
+(batcher ``src/batcher.py:38-51``, router ``src/router.py:57-79``, load
+balancer ``src/load_balancer.py:42-60``, cache ``src/kvstore.py:38-54``),
+promoted into one typed config tree with a file loader — the config file the
+reference README promised (``README.md:39`` names a ``demo_config.yaml`` that
+never existed).
+
+Everything is a frozen-ish dataclass so configs hash cleanly and can be passed
+through jit boundaries as static arguments where needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ModelConfig:
+    """Per-model deployment config (reference ``src/config.py:12-20``).
+
+    The reference carried name/path/batch-size/IO-schema; the TPU engine adds
+    the fields a real model needs: architecture family, dtype, parallelism.
+    """
+
+    name: str
+    path: str = ""                     # HF checkpoint dir (safetensors) or "" for random init
+    version: str = "1.0"
+    architecture: str = "fake"         # "fake" | "gpt2" | "llama"
+    dtype: str = "bfloat16"
+    batch_size: int = 1
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    quantized: bool = False
+    input_schema: Dict[str, str] = field(default_factory=dict)
+    output_schema: Dict[str, str] = field(default_factory=dict)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh axes. Axis order is (dp, pp, sp, tp) — outermost to
+    innermost — so tensor-parallel collectives ride the fastest (ICI) links.
+
+    ep (expert parallel) is folded onto the tp axis when unused; reserved as a
+    first-class axis name for MoE models (SURVEY.md §2.3).
+    """
+
+    dp: int = 1      # data parallel (replica) axis
+    pp: int = 1      # pipeline stage axis
+    sp: int = 1      # sequence/context parallel axis (ring attention)
+    tp: int = 1      # tensor parallel axis
+    ep: int = 1      # expert parallel axis (MoE only)
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp, "ep": self.ep}
+
+
+@dataclass
+class EngineConfig:
+    """Execution-engine knobs: shapes must be static for XLA (SURVEY.md §7
+    hard-part #1), so every dynamic quantity is bucketed here."""
+
+    max_seq_len: int = 2048
+    max_slots: int = 8                 # concurrent sequences in the decode batch
+    prefill_buckets: List[int] = field(default_factory=lambda: [128, 512, 2048])
+    page_size: int = 128               # tokens per KV page (paged cache)
+    num_pages: int = 512               # HBM page pool size
+    dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"
+    decode_steps_per_call: int = 8     # tokens generated per jit dispatch (lax.scan)
+    use_paged_kv: bool = False
+    attention_impl: str = "auto"       # "auto" | "xla" | "pallas"
+
+
+@dataclass
+class BatcherConfig:
+    """Reference ``src/batcher.py:38-51``: flush at max_batch_size OR after
+    max_latency_ms, whichever first."""
+
+    max_batch_size: int = 8
+    max_latency_ms: float = 50.0
+    pad_to_buckets: bool = True        # pad batches to power-of-two buckets for XLA
+
+
+@dataclass
+class CacheConfig:
+    """Reference ``src/kvstore.py:38-54``."""
+
+    max_size: int = 1024
+    policy: str = "lru"                # "lru" | "lfu" | "fifo"
+    default_ttl: Optional[float] = None
+
+
+@dataclass
+class HealthConfig:
+    """Reference ``src/router.py:57-79`` / ``src/load_balancer.py:42-60``:
+    probe cadence + N-consecutive-failures threshold."""
+
+    check_interval: float = 5.0
+    check_timeout: float = 2.0
+    max_consecutive_failures: int = 3
+    enable_failover: bool = True
+
+
+@dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 = OS-assigned, like reference src/worker.py:58-59
+    worker_id: str = "worker-0"
+    request_timeout: float = 30.0      # reference src/worker.py:93
+    max_frame_bytes: int = 64 * 1024 * 1024
+
+
+@dataclass
+class Config:
+    """Root config: engine/mesh/serving/cluster sections (SURVEY.md §5
+    config-system plan)."""
+
+    models: List[ModelConfig] = field(default_factory=list)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    batcher: BatcherConfig = field(default_factory=BatcherConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _build(cls, d: Dict[str, Any]):
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def config_from_dict(d: Dict[str, Any]) -> Config:
+    cfg = Config()
+    if "models" in d:
+        cfg.models = [ModelConfig.from_dict(m) for m in d["models"]]
+    for section, cls in (
+        ("mesh", MeshConfig),
+        ("engine", EngineConfig),
+        ("batcher", BatcherConfig),
+        ("cache", CacheConfig),
+        ("health", HealthConfig),
+        ("server", ServerConfig),
+    ):
+        if section in d:
+            setattr(cfg, section, _build(cls, d[section]))
+    return cfg
+
+
+def load_config(path: str) -> Config:
+    """Load a Config from JSON, TOML, or YAML by extension."""
+    p = pathlib.Path(path)
+    text = p.read_text()
+    if p.suffix in (".json",):
+        data = json.loads(text)
+    elif p.suffix in (".toml",):
+        import tomllib
+
+        data = tomllib.loads(text)
+    elif p.suffix in (".yaml", ".yml"):
+        import yaml
+
+        data = yaml.safe_load(text)
+    else:
+        raise ValueError(f"unsupported config extension: {p.suffix}")
+    return config_from_dict(data or {})

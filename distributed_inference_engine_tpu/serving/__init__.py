@@ -1,0 +1,2 @@
+from .cache import ResponseCache, KVStore, create_kv_store, EvictionPolicy  # noqa: F401
+from .batcher import Batcher, BatchedRequest, Batch  # noqa: F401

@@ -1,0 +1,249 @@
+"""Host-side response/prefix cache with LRU/LFU/FIFO eviction and TTL.
+
+Capability heir of the reference's ``src/kvstore.py:26-236`` (``KVCache``:
+eviction policies ``:63-102``, set/get ``:104-164``, batch ops ``:166-176``,
+stats ``:206-219``), with the fixes its own test suite demanded: the reference
+tests call ``close()``, item access, and context-manager use that the shipped
+class never implemented (``tests/test_kvstore.py:14,41,99-104`` — SURVEY.md §4),
+and the class claims thread safety (``src/kvstore.py:35``) without any lock.
+This implementation ships that full API and takes a real ``threading.RLock``.
+
+This is the *host* cache (responses, prefixes, metadata). The attention-state
+KV cache lives in HBM under ``engine/kv_cache.py`` — the north-star
+reinterpretation of the same component (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Tuple
+
+
+class EvictionPolicy(str, enum.Enum):
+    LRU = "lru"
+    LFU = "lfu"
+    FIFO = "fifo"
+
+
+@dataclass
+class CacheEntry:
+    """One cached value (reference ``src/kvstore.py:17-24``)."""
+
+    value: Any
+    created_at: float = field(default_factory=time.monotonic)
+    last_accessed: float = field(default_factory=time.monotonic)
+    ttl: Optional[float] = None
+    access_count: int = 0
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        if self.ttl is None:
+            return False
+        return (now if now is not None else time.monotonic()) - self.created_at >= self.ttl
+
+
+class ResponseCache:
+    """In-memory cache with pluggable eviction, per-entry TTL, batch ops, and
+    hit/miss/eviction stats. Thread-safe.
+
+    Insertion order is tracked by the underlying ``OrderedDict`` (FIFO),
+    recency by move-to-end on access (LRU), and frequency by per-entry access
+    counts (LFU) — one structure, three policies.
+    """
+
+    def __init__(
+        self,
+        max_size: int = 1024,
+        policy: str | EvictionPolicy = EvictionPolicy.LRU,
+        default_ttl: Optional[float] = None,
+    ) -> None:
+        if max_size <= 0:
+            raise ValueError("max_size must be positive")
+        self.max_size = max_size
+        self.policy = EvictionPolicy(policy)
+        self.default_ttl = default_ttl
+        self._entries: "OrderedDict[Hashable, CacheEntry]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._closed = False
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._expirations = 0
+
+    # ---------------------------------------------------------------- core
+
+    def set(self, key: Hashable, value: Any, ttl: Optional[float] = None) -> None:
+        with self._lock:
+            self._check_open()
+            if key in self._entries:
+                del self._entries[key]
+            self._evict_if_needed()
+            self._entries[key] = CacheEntry(
+                value=value, ttl=ttl if ttl is not None else self.default_ttl
+            )
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            self._check_open()
+            entry = self._entries.get(key)
+            if entry is None:
+                self._misses += 1
+                return default
+            if entry.is_expired():
+                del self._entries[key]
+                self._expirations += 1
+                self._misses += 1
+                return default
+            entry.last_accessed = time.monotonic()
+            entry.access_count += 1
+            if self.policy is EvictionPolicy.LRU:
+                self._entries.move_to_end(key)
+            self._hits += 1
+            return entry.value
+
+    def batch_get(self, keys: Iterable[Hashable]) -> Dict[Hashable, Any]:
+        """Reference ``src/kvstore.py:166-168`` — only present keys appear."""
+        sentinel = object()
+        out = {}
+        for k in keys:
+            v = self.get(k, sentinel)
+            if v is not sentinel:
+                out[k] = v
+        return out
+
+    def batch_set(
+        self, items: Dict[Hashable, Any], ttl: Optional[float] = None
+    ) -> None:
+        for k, v in items.items():
+            self.set(k, v, ttl)
+
+    def delete(self, key: Hashable) -> bool:
+        with self._lock:
+            self._check_open()
+            if key in self._entries:
+                del self._entries[key]
+                return True
+            return False
+
+    def clear(self) -> int:
+        with self._lock:
+            self._check_open()
+            n = len(self._entries)
+            self._entries.clear()
+            return n
+
+    # ------------------------------------------------------------ eviction
+
+    def _evict_if_needed(self) -> None:
+        # expired entries go first — evicting them is free capacity
+        while len(self._entries) >= self.max_size:
+            expired = self._pick_expired()
+            victim = expired if expired is not None else self._pick_victim()
+            if victim is None:
+                return
+            del self._entries[victim]
+            if expired is not None:
+                self._expirations += 1   # TTL churn, not capacity pressure
+            else:
+                self._evictions += 1
+
+    def _pick_expired(self) -> Optional[Hashable]:
+        now = time.monotonic()
+        for k, e in self._entries.items():
+            if e.is_expired(now):
+                return k
+        return None
+
+    def _pick_victim(self) -> Optional[Hashable]:
+        if not self._entries:
+            return None
+        if self.policy in (EvictionPolicy.LRU, EvictionPolicy.FIFO):
+            # LRU: least-recently-used is at the front (move_to_end on access).
+            # FIFO: insertion order is the front (never reordered).
+            return next(iter(self._entries))
+        # LFU: smallest access count; ties broken by age (iteration order)
+        return min(self._entries.items(), key=lambda kv: kv[1].access_count)[0]
+
+    # --------------------------------------------------------------- stats
+
+    def get_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "size": len(self._entries),
+                "max_size": self.max_size,
+                "policy": self.policy.value,
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_rate": (self._hits / total) if total else 0.0,
+                "evictions": self._evictions,
+                "expirations": self._expirations,
+            }
+
+    # ------------------------------------------------- dunder / lifecycle
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise RuntimeError("cache is closed")
+
+    def close(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._closed = True
+
+    def __enter__(self) -> "ResponseCache":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __getitem__(self, key: Hashable) -> Any:
+        sentinel = object()
+        v = self.get(key, sentinel)
+        if v is sentinel:
+            raise KeyError(key)
+        return v
+
+    def __setitem__(self, key: Hashable, value: Any) -> None:
+        self.set(key, value)
+
+    def __delitem__(self, key: Hashable) -> None:
+        if not self.delete(key):
+            raise KeyError(key)
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            self._check_open()
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            if entry.is_expired():
+                del self._entries[key]
+                self._expirations += 1
+                return False
+            return True
+
+    def __len__(self) -> int:
+        """Live entry count; sweeps expired entries first (reference
+        ``src/kvstore.py:230-236`` semantics)."""
+        with self._lock:
+            self._check_open()
+            now = time.monotonic()
+            dead = [k for k, e in self._entries.items() if e.is_expired(now)]
+            for k in dead:
+                del self._entries[k]
+                self._expirations += 1
+            return len(self._entries)
+
+    def keys(self) -> List[Hashable]:
+        with self._lock:
+            self._check_open()
+            return list(self._entries.keys())
+
+
+# Aliases matching the reference's public names (``src/kvstore.py:238-240``).
+KVStore = ResponseCache
+create_kv_store = ResponseCache

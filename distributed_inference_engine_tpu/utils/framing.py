@@ -1,0 +1,105 @@
+"""Length-prefixed wire framing for the host RPC plane.
+
+The reference's worker reads a single ``reader.read(4096)`` per connection
+(``src/worker.py:93``), silently breaking any request over 4 KiB or split
+across TCP segments; its README *declares* a ``utils.py`` with proper
+length-prefixed framing (``README.md:100-102``) that was never written. This
+module is that promise, delivered: every message on the wire is
+
+    | magic u16 | codec u8 | flags u8 | length u32 (big-endian) | payload |
+
+with JSON and msgpack codecs. Only the control plane uses this — tensor
+traffic between chips is XLA collectives over ICI/DCN, never hand-rolled
+sockets (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Tuple
+
+try:
+    import msgpack
+
+    _HAS_MSGPACK = True
+except ImportError:  # pragma: no cover
+    _HAS_MSGPACK = False
+
+MAGIC = 0xD17E
+HEADER = struct.Struct(">HBBI")  # magic, codec, flags, length
+HEADER_SIZE = HEADER.size
+
+CODEC_JSON = 0
+CODEC_MSGPACK = 1
+
+DEFAULT_MAX_FRAME = 64 * 1024 * 1024
+
+
+class FrameError(Exception):
+    """Raised on malformed frames (bad magic, oversize, unknown codec)."""
+
+
+def encode_frame(obj: Any, codec: int = CODEC_MSGPACK) -> bytes:
+    if codec == CODEC_MSGPACK and _HAS_MSGPACK:
+        payload = msgpack.packb(obj, use_bin_type=True)
+    else:
+        codec = CODEC_JSON
+        payload = json.dumps(obj).encode("utf-8")
+    return HEADER.pack(MAGIC, codec, 0, len(payload)) + payload
+
+
+def decode_frame(data: bytes, max_frame: int = DEFAULT_MAX_FRAME) -> Tuple[Any, int]:
+    """Decode one frame from ``data``. Returns (object, bytes_consumed).
+
+    Raises FrameError on corruption; raises IncompleteFrame via returning
+    consumed=0 is NOT done — callers that stream should use read_frame.
+    """
+    if len(data) < HEADER_SIZE:
+        raise FrameError("short header")
+    magic, codec, _flags, length = HEADER.unpack_from(data)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic 0x{magic:04x}")
+    if length > max_frame:
+        raise FrameError(f"frame of {length} bytes exceeds max {max_frame}")
+    if len(data) < HEADER_SIZE + length:
+        raise FrameError("short payload")
+    payload = data[HEADER_SIZE : HEADER_SIZE + length]
+    return _decode_payload(codec, payload), HEADER_SIZE + length
+
+
+def _decode_payload(codec: int, payload: bytes) -> Any:
+    if codec == CODEC_JSON:
+        return json.loads(payload.decode("utf-8"))
+    if codec == CODEC_MSGPACK:
+        if not _HAS_MSGPACK:
+            raise FrameError("msgpack frame but msgpack unavailable")
+        return msgpack.unpackb(payload, raw=False)
+    raise FrameError(f"unknown codec {codec}")
+
+
+async def read_frame(
+    reader: asyncio.StreamReader, max_frame: int = DEFAULT_MAX_FRAME
+) -> Any:
+    """Read exactly one framed message from the stream.
+
+    Raises asyncio.IncompleteReadError on clean EOF mid-frame, FrameError on
+    corruption. Unlike the reference's single read() call, this always
+    receives complete messages regardless of TCP segmentation.
+    """
+    header = await reader.readexactly(HEADER_SIZE)
+    magic, codec, _flags, length = HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic 0x{magic:04x}")
+    if length > max_frame:
+        raise FrameError(f"frame of {length} bytes exceeds max {max_frame}")
+    payload = await reader.readexactly(length)
+    return _decode_payload(codec, payload)
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter, obj: Any, codec: int = CODEC_MSGPACK
+) -> None:
+    writer.write(encode_frame(obj, codec))
+    await writer.drain()

@@ -1,0 +1,117 @@
+"""Request tracing: real request IDs propagated end-to-end with per-phase
+timestamps.
+
+The reference README promises "request tracing" (``README.md:18``) but only
+``FakeModel`` fabricates a request_id that never leaves the mock
+(``src/mock_models/fake_model.py:56``); the worker logs per-connection
+durations (``src/worker.py:126-133``) with no correlation id. Here a
+``RequestTrace`` travels with each request and records queue/prefill/decode
+phase boundaries — the timestamps that produce TTFT and tok/s, the
+BASELINE.json metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class RequestTrace:
+    """Monotonic per-phase marks for one request's lifetime.
+
+    Canonical phases: received, queued, batched, prefill_start, prefill_end,
+    first_token, decode_end, responded.
+    """
+
+    request_id: str = field(default_factory=new_request_id)
+    marks: Dict[str, float] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if "received" not in self.marks:
+            self.mark("received")
+
+    def mark(self, phase: str) -> float:
+        t = time.monotonic()
+        self.marks.setdefault(phase, t)   # first mark wins (first_token semantics)
+        return t
+
+    def span(self, start: str, end: str) -> Optional[float]:
+        if start in self.marks and end in self.marks:
+            return self.marks[end] - self.marks[start]
+        return None
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token: received → first_token."""
+        return self.span("received", "first_token")
+
+    @property
+    def total(self) -> Optional[float]:
+        return self.span("received", "responded")
+
+    def to_dict(self) -> Dict[str, float]:
+        base = self.marks.get("received", 0.0)
+        d = {k: v - base for k, v in self.marks.items()}
+        d["request_id"] = self.request_id  # type: ignore[assignment]
+        return d
+
+
+@contextlib.contextmanager
+def trace_span(trace: Optional[RequestTrace], start: str, end: str) -> Iterator[None]:
+    if trace is not None:
+        trace.mark(start)
+    try:
+        yield
+    finally:
+        if trace is not None:
+            trace.mark(end)
+
+
+class LatencyStats:
+    """Streaming latency accumulator with percentile snapshots.
+
+    Keeps a bounded reservoir so long-running workers don't grow unboundedly.
+    """
+
+    def __init__(self, reservoir: int = 4096) -> None:
+        self._samples: list[float] = []
+        self._reservoir = reservoir
+        self.count = 0
+        self.total = 0.0
+
+    def add(self, latency_s: float) -> None:
+        self.count += 1
+        self.total += latency_s
+        if len(self._samples) < self._reservoir:
+            self._samples.append(latency_s)
+        else:
+            # deterministic decimation: overwrite round-robin
+            self._samples[self.count % self._reservoir] = latency_s
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))
+        return s[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean_s": self.mean,
+            "p50_s": self.percentile(50),
+            "p95_s": self.percentile(95),
+            "p99_s": self.percentile(99),
+        }

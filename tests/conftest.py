@@ -1,0 +1,40 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform so all
+mesh/sharding/collective code is exercised without a TPU (SURVEY.md §4 —
+the multi-device-without-a-cluster strategy).
+
+Must run before anything imports jax, hence module-level os.environ writes in
+conftest. bench.py and the graft entry do NOT import this and run on real
+hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run the test in an event loop")
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests in a fresh event loop (pytest-asyncio is not
+    in the baked image, so the harness provides its own minimal runner)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,174 @@
+"""Batcher tests — size trigger, latency trigger, future fan-out, error
+fan-out, drain-on-stop, bucket padding, stats schema (the reference demo
+crashed on its own stats schema — SURVEY.md §5)."""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.serving.batcher import Batcher, PAD_INPUT
+
+
+class RecordingBackend:
+    """Fake engine backend: batch-shaped callback with injectable latency and
+    failure, in the spirit of the reference's mock_batch_inference
+    (``src/mock_models/mock_inference.py:31-53``)."""
+
+    def __init__(self, latency_s=0.0, fail=False, short_results=False):
+        self.calls = []
+        self.latency_s = latency_s
+        self.fail = fail
+        self.short_results = short_results
+
+    async def __call__(self, model, version, inputs):
+        self.calls.append((model, version, list(inputs)))
+        if self.latency_s:
+            await asyncio.sleep(self.latency_s)
+        if self.fail:
+            raise RuntimeError("backend exploded")
+        results = [{"echo": x} for x in inputs]
+        return results[:-1] if self.short_results else results
+
+
+@pytest.mark.asyncio
+async def test_size_trigger_flushes_full_batches():
+    be = RecordingBackend()
+    b = Batcher(be, max_batch_size=5, max_latency_ms=10_000)
+    await b.start()
+    futs = [await b.add_request("m", "1", {"i": i}) for i in range(12)]
+    # two full batches flush immediately; 2 stragglers wait on the timer
+    await asyncio.sleep(0.05)
+    assert len(be.calls) == 2
+    await b.stop()      # drain flushes the remainder
+    results = await asyncio.gather(*futs)
+    assert len(be.calls) == 3
+    sizes = [len(c[2]) for c in be.calls]
+    assert sizes == [5, 5, 2]
+    assert [r["echo"]["i"] for r in results] == list(range(12))
+
+
+@pytest.mark.asyncio
+async def test_latency_trigger():
+    be = RecordingBackend()
+    b = Batcher(be, max_batch_size=100, max_latency_ms=30)
+    await b.start()
+    fut = await b.add_request("m", "1", "x")
+    assert not fut.done()
+    res = await asyncio.wait_for(fut, timeout=2.0)
+    assert res == {"echo": "x"}
+    assert len(be.calls) == 1
+    await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_per_model_version_isolation():
+    be = RecordingBackend()
+    b = Batcher(be, max_batch_size=2, max_latency_ms=10_000)
+    await b.start()
+    f1 = await b.add_request("a", "1", 1)
+    f2 = await b.add_request("b", "1", 2)
+    f3 = await b.add_request("a", "2", 3)
+    f4 = await b.add_request("a", "1", 4)   # completes the ("a","1") batch
+    await asyncio.gather(f1, f4)
+    assert len(be.calls) == 1
+    assert be.calls[0][:2] == ("a", "1")
+    await b.stop()
+    await asyncio.gather(f2, f3)
+    assert len(be.calls) == 3
+
+
+@pytest.mark.asyncio
+async def test_error_fan_out():
+    be = RecordingBackend(fail=True)
+    b = Batcher(be, max_batch_size=2, max_latency_ms=10_000)
+    await b.start()
+    f1 = await b.add_request("m", "1", 1)
+    f2 = await b.add_request("m", "1", 2)
+    with pytest.raises(RuntimeError, match="exploded"):
+        await f1
+    with pytest.raises(RuntimeError, match="exploded"):
+        await f2
+    assert b.get_stats()["total_errors"] == 1
+    await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_short_result_count_fans_error():
+    be = RecordingBackend(short_results=True)
+    b = Batcher(be, max_batch_size=2, max_latency_ms=10_000)
+    await b.start()
+    f1 = await b.add_request("m", "1", 1)
+    f2 = await b.add_request("m", "1", 2)
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError):
+            await f
+    await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_bucket_padding():
+    be = RecordingBackend()
+    b = Batcher(be, max_batch_size=8, max_latency_ms=20, bucket_sizes=[2, 4, 8])
+    await b.start()
+    futs = [await b.add_request("m", "1", i) for i in range(3)]
+    results = await asyncio.gather(*futs)
+    assert [r["echo"] for r in results] == [0, 1, 2]
+    # backend saw the batch padded up to bucket 4
+    assert len(be.calls[0][2]) == 4
+    assert be.calls[0][2][3] is PAD_INPUT
+    await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_stop_drains_pending():
+    be = RecordingBackend(latency_s=0.02)
+    b = Batcher(be, max_batch_size=100, max_latency_ms=60_000)
+    await b.start()
+    futs = [await b.add_request("m", "1", i) for i in range(3)]
+    await b.stop()
+    results = await asyncio.gather(*futs)
+    assert len(results) == 3
+
+
+@pytest.mark.asyncio
+async def test_add_after_stop_raises():
+    b = Batcher(RecordingBackend(), max_batch_size=2)
+    await b.start()
+    await b.stop()
+    with pytest.raises(RuntimeError):
+        await b.add_request("m", "1", 1)
+
+
+@pytest.mark.asyncio
+async def test_stats_schema():
+    be = RecordingBackend()
+    b = Batcher(be, max_batch_size=2, max_latency_ms=10_000)
+    await b.start()
+    f1 = await b.add_request("m", "1", 1)
+    f2 = await b.add_request("m", "1", 2)
+    await asyncio.gather(f1, f2)
+    s = b.get_stats()
+    for key in (
+        "running", "total_requests", "total_batches", "total_batched_requests",
+        "total_errors", "avg_batch_size", "pending_batches", "pending_requests",
+        "inflight_batches", "max_batch_size", "max_latency_ms",
+    ):
+        assert key in s
+    assert s["total_requests"] == 2
+    assert s["total_batches"] == 1
+    assert s["avg_batch_size"] == 2.0
+    await b.stop()
+
+
+def test_ctor_validation():
+    with pytest.raises(ValueError):
+        Batcher(RecordingBackend(), max_batch_size=0)
+    with pytest.raises(ValueError):
+        Batcher(RecordingBackend(), max_batch_size=4, max_latency_ms=-1)
+    with pytest.raises(ValueError):
+        Batcher(RecordingBackend(), max_batch_size=8, bucket_sizes=[2, 4])
+
+
+def test_empty_bucket_sizes_means_no_buckets():
+    b = Batcher(RecordingBackend(), max_batch_size=4, bucket_sizes=[])
+    assert b.bucket_sizes is None

@@ -1,0 +1,195 @@
+"""Response cache tests.
+
+Covers the reference's kvstore test intent (``tests/test_kvstore.py``) —
+including the API surface those tests expected but the reference never
+implemented (close/item access/context manager — SURVEY.md §4).
+"""
+
+import time
+
+import pytest
+
+from distributed_inference_engine_tpu.serving.cache import (
+    EvictionPolicy,
+    ResponseCache,
+    KVStore,
+    create_kv_store,
+)
+
+
+def test_basic_set_get_delete():
+    c = ResponseCache(max_size=10)
+    c.set("a", 1)
+    c.set("b", {"x": [1, 2]})
+    assert c.get("a") == 1
+    assert c.get("b") == {"x": [1, 2]}
+    assert c.get("missing") is None
+    assert c.get("missing", 42) == 42
+    assert c.delete("a") is True
+    assert c.delete("a") is False
+    assert "a" not in c
+    assert "b" in c
+
+
+def test_item_access_and_context_manager():
+    with ResponseCache(max_size=4) as c:
+        c["k"] = "v"
+        assert c["k"] == "v"
+        del c["k"]
+        with pytest.raises(KeyError):
+            c["k"]
+        with pytest.raises(KeyError):
+            del c["nope"]
+    # closed on exit
+    with pytest.raises(RuntimeError):
+        c.set("x", 1)
+
+
+def test_ttl_expiry():
+    c = ResponseCache(max_size=10, default_ttl=0.05)
+    c.set("short", 1)
+    c.set("long", 2, ttl=10.0)
+    c.set("forever", 3, ttl=None)  # explicit None still uses default
+    assert c.get("short") == 1
+    time.sleep(0.07)
+    assert c.get("short") is None
+    assert c.get("long") == 2
+    stats = c.get_stats()
+    assert stats["expirations"] >= 1
+
+
+def test_len_sweeps_expired():
+    c = ResponseCache(max_size=10)
+    c.set("a", 1, ttl=0.01)
+    c.set("b", 2)
+    time.sleep(0.03)
+    assert len(c) == 1
+
+
+def test_lru_eviction_order():
+    c = ResponseCache(max_size=3, policy="lru")
+    c.set("a", 1)
+    c.set("b", 2)
+    c.set("c", 3)
+    c.get("a")          # refresh a → b is now least recent
+    c.set("d", 4)       # evicts b
+    assert "b" not in c
+    assert all(k in c for k in ("a", "c", "d"))
+    assert c.get_stats()["evictions"] == 1
+
+
+def test_lfu_eviction():
+    c = ResponseCache(max_size=3, policy=EvictionPolicy.LFU)
+    c.set("a", 1)
+    c.set("b", 2)
+    c.set("c", 3)
+    for _ in range(3):
+        c.get("a")
+    c.get("b")
+    c.set("d", 4)       # c has 0 accesses → evicted
+    assert "c" not in c
+    assert all(k in c for k in ("a", "b", "d"))
+
+
+def test_fifo_eviction():
+    c = ResponseCache(max_size=3, policy="fifo")
+    c.set("a", 1)
+    c.set("b", 2)
+    c.set("c", 3)
+    c.get("a")          # access must NOT save "a" under FIFO
+    c.set("d", 4)
+    assert "a" not in c
+    assert all(k in c for k in ("b", "c", "d"))
+
+
+def test_batch_ops():
+    c = ResponseCache(max_size=10)
+    c.batch_set({"a": 1, "b": 2, "c": 3})
+    out = c.batch_get(["a", "c", "zz"])
+    assert out == {"a": 1, "c": 3}
+
+
+def test_stats_hit_rate():
+    c = ResponseCache(max_size=10)
+    c.set("a", 1)
+    c.get("a")
+    c.get("a")
+    c.get("miss")
+    s = c.get_stats()
+    assert s["hits"] == 2 and s["misses"] == 1
+    assert abs(s["hit_rate"] - 2 / 3) < 1e-9
+
+
+def test_clear_and_overwrite():
+    c = ResponseCache(max_size=10)
+    c.set("a", 1)
+    c.set("a", 2)
+    assert c.get("a") == 2
+    c.set("b", 1)
+    assert c.clear() == 2
+    assert len(c) == 0
+
+
+def test_type_round_trips():
+    c = ResponseCache(max_size=10)
+    values = [1, 1.5, "s", b"bytes", [1, 2], {"k": "v"}, (1, 2), None, True]
+    for i, v in enumerate(values):
+        c.set(f"k{i}", v)
+    for i, v in enumerate(values):
+        assert c.get(f"k{i}", "MISSING") == v
+
+
+def test_aliases():
+    assert KVStore is ResponseCache
+    assert create_kv_store is ResponseCache
+
+
+def test_eviction_prefers_expired():
+    c = ResponseCache(max_size=2, policy="lru")
+    c.set("fresh", 1)
+    c.set("stale", 2, ttl=0.01)
+    time.sleep(0.03)
+    c.set("new", 3)     # stale is expired → evicted even though fresh is LRU
+    assert "fresh" in c and "new" in c
+
+
+def test_thread_safety_smoke():
+    import threading
+
+    c = ResponseCache(max_size=64)
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(500):
+                c.set(f"{tid}-{i % 70}", i)
+                c.get(f"{tid}-{(i + 1) % 70}")
+                len(c)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_closed_cache_rejects_all_reads():
+    c = ResponseCache(max_size=4)
+    c.set("k", 1)
+    c.close()
+    for op in (lambda: "k" in c, lambda: len(c), lambda: c.keys(), lambda: c.get("k")):
+        with pytest.raises(RuntimeError):
+            op()
+
+
+def test_expiry_during_eviction_counts_as_expiration():
+    c = ResponseCache(max_size=2)
+    c.set("stale", 1, ttl=0.01)
+    c.set("fresh", 2)
+    time.sleep(0.03)
+    c.set("new", 3)
+    s = c.get_stats()
+    assert s["expirations"] == 1 and s["evictions"] == 0

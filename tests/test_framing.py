@@ -1,0 +1,79 @@
+"""Wire-framing tests: the protocol the reference README promised
+(``README.md:100-102``) and the 4 KiB bug it shipped instead
+(``src/worker.py:93``) — large and segmented messages must survive."""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.utils.framing import (
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    FrameError,
+    decode_frame,
+    encode_frame,
+    read_frame,
+    write_frame,
+)
+
+
+@pytest.mark.parametrize("codec", [CODEC_JSON, CODEC_MSGPACK])
+def test_round_trip(codec):
+    msg = {"op": "infer", "inputs": [1, 2.5, "x", None, True], "nested": {"a": [1]}}
+    buf = encode_frame(msg, codec)
+    out, consumed = decode_frame(buf)
+    assert out == msg
+    assert consumed == len(buf)
+
+
+def test_large_message_over_4k():
+    # the exact case the reference silently truncates
+    msg = {"blob": "x" * 200_000}
+    out, _ = decode_frame(encode_frame(msg))
+    assert out == msg
+
+
+def test_bad_magic_and_oversize():
+    buf = bytearray(encode_frame({"a": 1}))
+    buf[0] ^= 0xFF
+    with pytest.raises(FrameError):
+        decode_frame(bytes(buf))
+    big = encode_frame({"blob": "y" * 1000})
+    with pytest.raises(FrameError):
+        decode_frame(big, max_frame=10)
+
+
+def test_multiple_frames_in_buffer():
+    buf = encode_frame({"i": 0}) + encode_frame({"i": 1})
+    m0, n0 = decode_frame(buf)
+    m1, n1 = decode_frame(buf[n0:])
+    assert m0 == {"i": 0} and m1 == {"i": 1}
+    assert n0 + n1 == len(buf)
+
+
+@pytest.mark.asyncio
+async def test_stream_framing_across_segments():
+    """Messages split into tiny TCP-like segments must reassemble."""
+    server_got = []
+
+    async def handler(reader, writer):
+        msg = await read_frame(reader)
+        server_got.append(msg)
+        await write_frame(writer, {"ack": msg["seq"]})
+        writer.close()
+
+    server = await asyncio.start_server(handler, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = {"seq": 7, "blob": "z" * 50_000}
+    raw = encode_frame(payload)
+    for i in range(0, len(raw), 1000):    # drip-feed in 1000-byte segments
+        writer.write(raw[i : i + 1000])
+        await writer.drain()
+    reply = await read_frame(reader)
+    assert reply == {"ack": 7}
+    assert server_got[0] == payload
+    writer.close()
+    server.close()
+    await server.wait_closed()
