@@ -21,6 +21,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 
+def build_dataclass(cls, d: Dict[str, Any]):
+    """Construct ``cls`` from a dict, dropping unknown keys — the one shared
+    deserialization rule for every config-ish dataclass in the framework."""
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in known})
+
+
 @dataclass
 class ModelConfig:
     """Per-model deployment config (reference ``src/config.py:12-20``).
@@ -47,8 +54,7 @@ class ModelConfig:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelConfig":
-        known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in d.items() if k in known})
+        return build_dataclass(cls, d)
 
 
 @dataclass(frozen=True)
@@ -147,11 +153,6 @@ class Config:
         return dataclasses.asdict(self)
 
 
-def _build(cls, d: Dict[str, Any]):
-    known = {f.name for f in dataclasses.fields(cls)}
-    return cls(**{k: v for k, v in d.items() if k in known})
-
-
 def config_from_dict(d: Dict[str, Any]) -> Config:
     cfg = Config()
     if "models" in d:
@@ -165,7 +166,7 @@ def config_from_dict(d: Dict[str, Any]) -> Config:
         ("server", ServerConfig),
     ):
         if section in d:
-            setattr(cfg, section, _build(cls, d[section]))
+            setattr(cfg, section, build_dataclass(cls, d[section]))
     return cfg
 
 

@@ -1,0 +1,2 @@
+from .kv_cache import SlotKVCache  # noqa: F401
+from .engine import Engine, GenerationRequest, GenerationResult  # noqa: F401

@@ -1,0 +1,311 @@
+"""The inference engine: jit-compiled prefill + chunked decode on TPU.
+
+This replaces the reference's mock inference core — ``FakeModel.predict``'s
+50–150 ms ``asyncio.sleep`` (``src/mock_models/fake_model.py:47``) — with a
+real XLA program, and is the component every host-side layer (worker, batcher,
+coordinator) ultimately dispatches into (the ``[HOT]`` line of SURVEY.md §3.1).
+
+Execution model (SURVEY.md §7 hard-part #1 — static shapes vs dynamic
+serving):
+
+- **Prefill** runs on (batch-bucket, seq-bucket) padded shapes; prompts are
+  right-padded, lengths carried as data. One compiled program per bucket
+  pair, reused forever after.
+- **Decode** is a ``lax.scan`` over ``decode_steps_per_call`` steps, entirely
+  on device: forward, sample, advance lengths, write KV — no host round-trip
+  per token. The host syncs once per chunk to test "is anyone still active",
+  amortizing the device→host latency over the chunk.
+- **Sampling knobs are data** (``SamplingParams`` arrays), so greedy and
+  nucleus requests share one compiled program.
+- **KV buffers are donated** into the decode chunk, so XLA mutates the HBM
+  cache in place instead of double-buffering ~GBs per step.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import EngineConfig
+from ..models.base import (
+    ModelSpec,
+    Params,
+    forward_decode,
+    forward_prefill,
+    init_params,
+    unembed,
+)
+from ..ops.sampling import SamplingParams, sample_tokens
+from ..utils.tracing import LatencyStats
+
+
+@dataclass
+class GenerationRequest:
+    """One generation job (token-id space; tokenization is a host concern)."""
+
+    prompt: List[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    request_id: str = ""
+    eos_id: int = -1                  # -1: never stops early
+
+
+@dataclass
+class GenerationResult:
+    request_id: str
+    tokens: List[int]                 # generated token ids (no prompt)
+    finish_reason: str                # "stop" | "length"
+    prompt_tokens: int = 0
+    ttft_s: float = 0.0               # prefill + first sample wall time
+    decode_s: float = 0.0
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    raise ValueError(f"size {n} exceeds largest bucket {max(buckets)}")
+
+
+def _pow2_buckets(cap: int, start: int = 1) -> List[int]:
+    out, b = [], start
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return out
+
+
+class Engine:
+    """Single-program inference engine over one model.
+
+    ``generate`` is synchronous device code; async callers (worker RPC,
+    batcher backend) wrap it in an executor thread. Mesh/sharding-aware
+    construction is layered in ``parallel/`` — the engine itself only sees
+    (possibly sharded) params and arrays.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        params: Optional[Params] = None,
+        config: Optional[EngineConfig] = None,
+        seed: int = 0,
+        shard_fn=None,   # optional: fn(params) -> sharded params (parallel/)
+    ) -> None:
+        self.spec = spec.validate()
+        self.config = config or EngineConfig()
+        if params is None:
+            params = init_params(spec, jax.random.key(seed))
+        if shard_fn is not None:
+            params = shard_fn(params)
+        self.params = params
+        self._rng = jax.random.key(seed + 1)
+
+        cfg = self.config
+        self.batch_buckets = _pow2_buckets(cfg.max_slots)
+        self.prefill_buckets = sorted(
+            b for b in cfg.prefill_buckets if b <= spec.max_seq_len
+        ) or [min(128, spec.max_seq_len)]
+        self.seq_buckets = _pow2_buckets(
+            min(cfg.max_seq_len, spec.max_seq_len), start=128
+        )
+
+        # ---- jitted programs (compiled per bucket shape, cached by jax)
+        spec_ = self.spec
+
+        @jax.jit
+        def _prefill(params, tokens, seq_lens):
+            hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
+            b = tokens.shape[0]
+            last = hidden[jnp.arange(b), seq_lens - 1]        # [B, D]
+            logits = unembed(spec_, params, last)             # [B, V] fp32
+            return logits, ks, vs
+
+        @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(1, 2, 3, 4, 5, 6))
+        def _decode_chunk(
+            params, ck, cv, lengths, last_tokens, active, produced,
+            max_new, sampling, eos_ids, key, n_steps: int,
+        ):
+            """n_steps of decode for every slot, fully on device.
+
+            Shapes: ck/cv [L,B,S,Hkv,Dh]; lengths/last_tokens/active/produced/
+            max_new/eos_ids [B]. Emits tokens [n_steps, B] (-1 for inactive).
+            """
+
+            def step(carry, step_key):
+                ck, cv, lengths, last, active, produced = carry
+                hidden, ck, cv = forward_decode(
+                    spec_, params, last, lengths, ck, cv
+                )
+                logits = unembed(spec_, params, hidden)        # [B, V]
+                next_tok = sample_tokens(logits, sampling, step_key)
+                was_active = active
+                produced = produced + was_active.astype(jnp.int32)
+                hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
+                done = hit_eos | (produced >= max_new)
+                active = was_active & ~done
+                lengths = lengths + was_active.astype(jnp.int32)
+                last = jnp.where(was_active, next_tok, last)
+                emitted = jnp.where(was_active, next_tok, -1)
+                return (ck, cv, lengths, last, active, produced), emitted
+
+            keys = jax.random.split(key, n_steps)
+            carry, toks = jax.lax.scan(
+                step, (ck, cv, lengths, last_tokens, active, produced), keys
+            )
+            return carry, toks
+
+        self._prefill = _prefill
+        self._decode_chunk = _decode_chunk
+
+        # ---- metrics
+        self.prefill_stats = LatencyStats()
+        self.decode_stats = LatencyStats()
+        self._total_requests = 0
+        self._total_prompt_tokens = 0
+        self._total_generated_tokens = 0
+        self._total_errors = 0
+
+    # ------------------------------------------------------------ generate
+
+    def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
+        """Run a batch of generation jobs to completion. Static-shape safe:
+        pads batch and sequence dims to buckets so repeat calls hit the jit
+        cache."""
+        if not requests:
+            return []
+        self._total_requests += len(requests)
+        n = len(requests)
+        bb = _next_bucket(n, self.batch_buckets)
+        max_prompt = max(len(r.prompt) for r in requests)
+        if min(len(r.prompt) for r in requests) < 1:
+            raise ValueError("empty prompt")
+        # overlong prompts keep their tail (sliding-window truncation)
+        max_prompt = min(max_prompt, max(self.prefill_buckets))
+        tb = _next_bucket(max_prompt, self.prefill_buckets)
+        max_new = max(r.max_new_tokens for r in requests)
+        total_cap = max(tb, _next_bucket(
+            min(max_prompt + max_new, self.seq_buckets[-1]), self.seq_buckets
+        ))
+
+        # ---- host-side batch assembly (numpy, then one transfer)
+        tokens = np.zeros((bb, tb), dtype=np.int32)
+        seq_lens = np.ones((bb,), dtype=np.int32)      # padded rows: len 1
+        max_new_arr = np.zeros((bb,), dtype=np.int32)
+        eos = np.full((bb,), -1, dtype=np.int32)
+        temps = np.zeros((bb,), dtype=np.float32)
+        top_k = np.zeros((bb,), dtype=np.int32)
+        top_p = np.ones((bb,), dtype=np.float32)
+        for i, r in enumerate(requests):
+            p = r.prompt[-tb:]                          # clamp overlong prompts
+            tokens[i, : len(p)] = p
+            seq_lens[i] = len(p)
+            max_new_arr[i] = max(1, min(r.max_new_tokens, total_cap - len(p)))
+            eos[i] = r.eos_id
+            temps[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+        sampling = SamplingParams(
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p)
+        )
+
+        t0 = time.perf_counter()
+        logits, ks, vs = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens)
+        )
+        self._rng, k0 = jax.random.split(self._rng)
+        first = sample_tokens(logits, sampling, k0)     # [bb]
+
+        # ---- seed decode state; KV cache sized to the total-seq bucket
+        L, Hkv, Dh = self.spec.n_layers, self.spec.n_kv_heads, self.spec.head_dim
+        dt = jnp.dtype(self.config.kv_dtype)
+        ck = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt)
+        cv = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt)
+        ck = ck.at[:, :, :tb].set(ks.astype(dt))
+        cv = cv.at[:, :, :tb].set(vs.astype(dt))
+
+        lengths = jnp.asarray(seq_lens)
+        is_real = np.zeros((bb,), dtype=bool)
+        is_real[:n] = True
+        first_np = np.asarray(first)
+        produced_np = is_real.astype(np.int32)          # the prefill sample
+        hit = is_real & (first_np == eos) & (eos >= 0)
+        active_np = is_real & ~hit & (produced_np < max_new_arr)
+        first_np = np.where(is_real, first_np, -1)
+
+        jax.block_until_ready(first)
+        ttft = time.perf_counter() - t0
+        self.prefill_stats.add(ttft)
+
+        out_tokens: List[List[int]] = [[int(first_np[i])] for i in range(n)]
+
+        active = jnp.asarray(active_np)
+        produced = jnp.asarray(produced_np)
+        last = jnp.asarray(np.where(first_np >= 0, first_np, 0).astype(np.int32))
+        max_new_j = jnp.asarray(max_new_arr)
+        eos_j = jnp.asarray(eos)
+
+        t1 = time.perf_counter()
+        n_steps = self.config.decode_steps_per_call
+        while bool(np.asarray(jax.device_get(active.any()))):
+            self._rng, kc = jax.random.split(self._rng)
+            (ck, cv, lengths, last, active, produced), toks = self._decode_chunk(
+                self.params, ck, cv, lengths, last, active, produced,
+                max_new_j, sampling, eos_j, kc, n_steps=n_steps,
+            )
+            toks_np = np.asarray(toks)                  # [n_steps, bb]
+            for i in range(n):
+                for s in range(n_steps):
+                    t = int(toks_np[s, i])
+                    if t >= 0:
+                        out_tokens[i].append(t)
+        decode_t = time.perf_counter() - t1
+        self.decode_stats.add(decode_t)
+
+        results = []
+        for i, r in enumerate(requests):
+            toks = out_tokens[i][: r.max_new_tokens]
+            stopped = r.eos_id >= 0 and r.eos_id in toks
+            if stopped:
+                toks = toks[: toks.index(r.eos_id) + 1]
+            self._total_prompt_tokens += len(r.prompt)
+            self._total_generated_tokens += len(toks)
+            results.append(
+                GenerationResult(
+                    request_id=r.request_id or f"gen-{self._total_requests}-{i}",
+                    tokens=toks,
+                    finish_reason="stop" if stopped else "length",
+                    prompt_tokens=len(r.prompt),
+                    ttft_s=ttft,
+                    decode_s=decode_t,
+                )
+            )
+        return results
+
+    # ------------------------------------------------------------- metrics
+
+    def get_metrics(self) -> Dict[str, Any]:
+        """Every component exposes get_stats/get_metrics (SURVEY.md §5)."""
+        return {
+            "total_requests": self._total_requests,
+            "total_prompt_tokens": self._total_prompt_tokens,
+            "total_generated_tokens": self._total_generated_tokens,
+            "total_errors": self._total_errors,
+            "prefill": self.prefill_stats.snapshot(),
+            "decode": self.decode_stats.snapshot(),
+            "spec": {
+                "n_layers": self.spec.n_layers,
+                "d_model": self.spec.d_model,
+                "vocab_size": self.spec.vocab_size,
+            },
+        }

@@ -1,0 +1,116 @@
+"""HBM-resident KV cache with slot management.
+
+The north-star reinterpretation of the reference's ``src/kvstore.py``
+(BASELINE.json: "kvstore.py is repurposed as an HBM-resident paged KV cache"):
+where the host-side ``ResponseCache`` caches responses, this caches the
+attention state that decoding reads every step — the true HBM-bandwidth hot
+path.
+
+v1 layout is slot-contiguous: ``[n_layers, max_slots, max_seq, n_kv_heads,
+head_dim]``. Each live sequence owns one slot row; a slot's live prefix is
+``lengths[slot]`` tokens. Slots are recycled through a free list, the direct
+analog of LRU page recycling at sequence granularity (page-granularity paging
+is layered on in ``ops/paged_attention.py``).
+
+JAX arrays are immutable: mutation happens inside jit via ``.at[].set`` with
+buffer donation, so XLA updates HBM in place — the class holds the current
+arrays and host-side slot accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.base import ModelSpec
+
+
+class SlotKVCache:
+    """Fixed-capacity slotted KV cache + free-list slot allocator."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        max_slots: int,
+        max_seq_len: Optional[int] = None,
+        dtype: Optional[str] = None,
+    ) -> None:
+        self.spec = spec
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len or spec.max_seq_len
+        self.dtype = jnp.dtype(dtype) if dtype else spec.jnp_dtype
+        shape = (
+            spec.n_layers,
+            max_slots,
+            self.max_seq_len,
+            spec.n_kv_heads,
+            spec.head_dim,
+        )
+        self.k = jnp.zeros(shape, dtype=self.dtype)
+        self.v = jnp.zeros(shape, dtype=self.dtype)
+        self._free: List[int] = list(range(max_slots))
+        self._live: Dict[int, str] = {}          # slot -> request_id
+
+    # -------------------------------------------------------------- slots
+
+    def alloc(self, request_id: str) -> Optional[int]:
+        """Claim a slot for a request; None when full (caller queues)."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._live[slot] = request_id
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot in self._live:
+            del self._live[slot]
+            self._free.append(slot)
+
+    def reset(self) -> None:
+        self._free = list(range(self.max_slots))
+        self._live = {}
+
+    @property
+    def live_slots(self) -> Dict[int, str]:
+        return dict(self._live)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    # ------------------------------------------------------------- device
+
+    def write_prefill(
+        self, ks: jnp.ndarray, vs: jnp.ndarray, slots: jnp.ndarray
+    ) -> None:
+        """Scatter prefilled K/V ([L, B, T, Hkv, Dh]) into slot rows."""
+        self.k = _write_rows(self.k, ks.astype(self.dtype), slots)
+        self.v = _write_rows(self.v, vs.astype(self.dtype), slots)
+
+    def swap(self, new_k: jnp.ndarray, new_v: jnp.ndarray) -> None:
+        """Adopt updated cache arrays returned by a jitted decode step."""
+        self.k, self.v = new_k, new_v
+
+    # -------------------------------------------------------------- stats
+
+    def get_stats(self) -> Dict[str, float]:
+        bytes_total = 2 * self.k.size * self.k.dtype.itemsize
+        return {
+            "max_slots": self.max_slots,
+            "live_slots": len(self._live),
+            "free_slots": len(self._free),
+            "utilization": len(self._live) / self.max_slots if self.max_slots else 0.0,
+            "hbm_bytes": bytes_total,
+            "hbm_gib": bytes_total / (1 << 30),
+            "max_seq_len": self.max_seq_len,
+        }
+
+
+@jax.jit
+def _write_rows(cache, fresh, slots):
+    # cache [L, N, S, H, D], fresh [L, B, T, H, D], slots [B]; T is static
+    # under jit (taken from fresh's shape), so this lowers to one scatter.
+    t = fresh.shape[2]
+    return cache.at[:, slots, :t].set(fresh)

@@ -1,0 +1,31 @@
+from .base import (  # noqa: F401
+    ModelSpec,
+    init_params,
+    forward_prefill,
+    forward_decode,
+    forward_train,
+    causal_lm_loss,
+    embed,
+    unembed,
+)
+from .gpt2 import gpt2_spec  # noqa: F401
+from .llama import llama_spec  # noqa: F401
+from .fake import FakeEngine  # noqa: F401
+
+
+def build_engine(architecture: str, **kwargs):
+    """Engine factory keyed by ``ModelConfig.architecture``."""
+    if architecture == "fake":
+        return FakeEngine(**{k: v for k, v in kwargs.items()
+                             if k in ("latency_s", "per_token_latency_s",
+                                      "error_rate", "seed")})
+    from ..engine.engine import Engine
+
+    if architecture.startswith("gpt2"):
+        spec = gpt2_spec(architecture if architecture in (
+            "gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl") else "gpt2")
+    elif architecture.startswith("llama"):
+        spec = llama_spec(architecture if "-" in architecture else "llama3-8b")
+    else:
+        raise ValueError(f"unknown architecture {architecture!r}")
+    return Engine(spec, **kwargs)

@@ -1,0 +1,311 @@
+"""Unified decoder-only transformer covering the GPT-2 and Llama families.
+
+This is the real engine the reference never had — its ``FakeModel.predict``
+is an asyncio sleep that echoes its input (``src/mock_models/fake_model.py:33-67``).
+Here a single spec-driven forward serves both model families
+(BASELINE.json configs[1-3]): GPT-2 = learned positions + LayerNorm + GELU
+MLP + biases + tied embeddings; Llama = RoPE + RMSNorm + SwiGLU + GQA, no
+biases.
+
+TPU-first design decisions:
+
+- **Stacked layers + lax.scan.** All per-layer weights carry a leading
+  ``[n_layers, ...]`` axis and the forward scans over them: XLA traces and
+  compiles ONE layer body instead of unrolling N copies (compile time stays
+  flat as models grow), and the stacked layout is exactly what pipeline
+  parallelism wants to split later.
+- **Params are a plain pytree** (nested dict of arrays), not framework
+  module state: ``jax.sharding.NamedSharding`` annotations attach directly,
+  the same tree feeds jit'd inference, the training step, and the checkpoint
+  loader, and donation works without adapters.
+- **Prefill and decode are separate functions** with different shapes —
+  prefill attends over the prompt's fresh K/V ([B, T]), decode attends over
+  the HBM cache ([B, S]) — so XLA compiles each for its own hot shape
+  instead of one program with dynamic behavior.
+- **bf16 weights/activations, fp32 softmax/norm/logits** — MXU-friendly
+  matmuls with fp32 where accumulation error actually matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import cached_attention, causal_attention
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rope import apply_rope
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Static architecture description; hashable so it can be a jit static arg."""
+
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 2048
+    pos_emb: str = "rope"          # "rope" | "learned"
+    norm: str = "rmsnorm"          # "rmsnorm" | "layernorm"
+    mlp: str = "swiglu"            # "swiglu" | "gelu"
+    use_bias: bool = False
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def validate(self) -> "ModelSpec":
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide by n_heads")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must divide by n_kv_heads")
+        if self.pos_emb not in ("rope", "learned"):
+            raise ValueError(f"unknown pos_emb {self.pos_emb}")
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelSpec":
+        from ..config import build_dataclass
+
+        return build_dataclass(cls, d).validate()
+
+
+# --------------------------------------------------------------------- init
+
+
+def init_params(spec: ModelSpec, key: jax.Array) -> Params:
+    """Random-init parameter tree (normal(0.02), depth-scaled output projs)."""
+    spec.validate()
+    dt = spec.jnp_dtype
+    L, D, F, V = spec.n_layers, spec.d_model, spec.d_ff, spec.vocab_size
+    H, Hkv, Dh = spec.n_heads, spec.n_kv_heads, spec.head_dim
+    keys = iter(jax.random.split(key, 16))
+    std = 0.02
+    out_std = std / jnp.sqrt(2.0 * L)   # GPT-2-style depth scaling
+
+    def norm_(shape, k, s=std):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * s).astype(dt)
+
+    blocks: Params = {
+        "ln1_scale": jnp.ones((L, D), dtype=dt),
+        "ln2_scale": jnp.ones((L, D), dtype=dt),
+        "wq": norm_((L, D, H * Dh), next(keys)),
+        "wk": norm_((L, D, Hkv * Dh), next(keys)),
+        "wv": norm_((L, D, Hkv * Dh), next(keys)),
+        "wo": norm_((L, H * Dh, D), next(keys), out_std),
+    }
+    if spec.mlp == "swiglu":
+        blocks["w_gate"] = norm_((L, D, F), next(keys))
+        blocks["w_up"] = norm_((L, D, F), next(keys))
+        blocks["w_down"] = norm_((L, F, D), next(keys), out_std)
+    else:
+        blocks["w_up"] = norm_((L, D, F), next(keys))
+        blocks["w_down"] = norm_((L, F, D), next(keys), out_std)
+    if spec.norm == "layernorm":
+        blocks["ln1_bias"] = jnp.zeros((L, D), dtype=dt)
+        blocks["ln2_bias"] = jnp.zeros((L, D), dtype=dt)
+    if spec.use_bias:
+        blocks["bq"] = jnp.zeros((L, H * Dh), dtype=dt)
+        blocks["bk"] = jnp.zeros((L, Hkv * Dh), dtype=dt)
+        blocks["bv"] = jnp.zeros((L, Hkv * Dh), dtype=dt)
+        blocks["bo"] = jnp.zeros((L, D), dtype=dt)
+        blocks["b_up"] = jnp.zeros((L, F), dtype=dt)
+        blocks["b_down"] = jnp.zeros((L, D), dtype=dt)
+
+    params: Params = {
+        "tok_emb": norm_((V, D), next(keys)),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((D,), dtype=dt),
+    }
+    if spec.norm == "layernorm":
+        params["lnf_bias"] = jnp.zeros((D,), dtype=dt)
+    if spec.pos_emb == "learned":
+        params["pos_emb"] = norm_((spec.max_seq_len, D), next(keys))
+    if not spec.tie_embeddings:
+        params["lm_head"] = norm_((D, V), next(keys))
+    return params
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _norm(spec: ModelSpec, x, scale, bias):
+    if spec.norm == "layernorm":
+        return layer_norm(x, scale, bias, spec.norm_eps)
+    return rms_norm(x, scale, spec.norm_eps)
+
+
+def _mlp(spec: ModelSpec, blk: Params, x):
+    if spec.mlp == "swiglu":
+        gate = jnp.einsum("btd,df->btf", x, blk["w_gate"])
+        up = jnp.einsum("btd,df->btf", x, blk["w_up"])
+        h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    else:
+        h = jnp.einsum("btd,df->btf", x, blk["w_up"])
+        if spec.use_bias:
+            h = h + blk["b_up"]
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=True).astype(x.dtype)
+    out = jnp.einsum("btf,fd->btd", h, blk["w_down"])
+    if spec.use_bias:
+        out = out + blk["b_down"]
+    return out
+
+
+def _qkv(spec: ModelSpec, blk: Params, x, positions):
+    b, t, _ = x.shape
+    H, Hkv, Dh = spec.n_heads, spec.n_kv_heads, spec.head_dim
+    q = jnp.einsum("btd,de->bte", x, blk["wq"])
+    k = jnp.einsum("btd,de->bte", x, blk["wk"])
+    v = jnp.einsum("btd,de->bte", x, blk["wv"])
+    if spec.use_bias:
+        q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
+    q = q.reshape(b, t, H, Dh)
+    k = k.reshape(b, t, Hkv, Dh)
+    v = v.reshape(b, t, Hkv, Dh)
+    if spec.pos_emb == "rope":
+        q = apply_rope(q, positions, spec.rope_theta)
+        k = apply_rope(k, positions, spec.rope_theta)
+    return q, k, v
+
+
+def _out_proj(spec: ModelSpec, blk: Params, attn_out):
+    b, t, h, dh = attn_out.shape
+    out = jnp.einsum("bte,ed->btd", attn_out.reshape(b, t, h * dh), blk["wo"])
+    if spec.use_bias:
+        out = out + blk["bo"]
+    return out
+
+
+def embed(spec: ModelSpec, params: Params, tokens: jnp.ndarray,
+          positions: jnp.ndarray) -> jnp.ndarray:
+    """[B, T] tokens -> [B, T, D] activations."""
+    x = params["tok_emb"][tokens]
+    if spec.pos_emb == "learned":
+        x = x + params["pos_emb"][positions]
+    return x
+
+
+def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + LM head. hidden [..., D] -> fp32 logits [..., V]."""
+    h = _norm(spec, hidden, params["lnf_scale"], params.get("lnf_bias"))
+    w = params["tok_emb"].T if spec.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...d,dv->...v", h.astype(jnp.float32), w.astype(jnp.float32))
+
+
+# ------------------------------------------------------------------ prefill
+
+
+def forward_prefill(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B, T] right-padded prompts
+    seq_lens: jnp.ndarray,   # [B] true prompt lengths
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the prompt through all layers.
+
+    Returns (hidden [B, T, D], k_cache [L, B, T, Hkv, Dh], v_cache [L, ...]):
+    the per-layer K/V to be written into cache slots by the engine.
+    """
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    x = embed(spec, params, tokens, positions)
+
+    def body(x, blk):
+        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+        q, k, v = _qkv(spec, blk, h, positions)
+        attn = causal_attention(q, k, v, seq_lens)
+        x = x + _out_proj(spec, blk, attn)
+        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+        x = x + _mlp(spec, blk, h2)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    return x, ks, vs
+
+
+# ------------------------------------------------------------------- decode
+
+
+def forward_decode(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B] the most recent token per slot
+    lengths: jnp.ndarray,    # [B] current length per slot (position of `tokens`)
+    cache_k: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+    cache_v: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step for every slot.
+
+    Writes each slot's new K/V at its own position (scatter), attends over the
+    slot's live prefix, and returns (hidden [B, D], new cache_k, new cache_v).
+    The caller advances ``lengths`` afterwards.
+    """
+    b = tokens.shape[0]
+    positions = lengths[:, None]                         # [B, 1]
+    x = embed(spec, params, tokens[:, None], positions)  # [B, 1, D]
+    batch_idx = jnp.arange(b)
+
+    def body(x, per_layer):
+        blk, ck, cv = per_layer
+        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+        q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
+        ck = ck.at[batch_idx, lengths].set(k[:, 0])
+        cv = cv.at[batch_idx, lengths].set(v[:, 0])
+        attn = cached_attention(q, ck, cv, lengths + 1)
+        x = x + _out_proj(spec, blk, attn)
+        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+        x = x + _mlp(spec, blk, h2)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
+    return x[:, 0, :], new_k, new_v
+
+
+# ---------------------------------------------------------------- training
+
+
+def forward_train(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B, T]
+    seq_lens: jnp.ndarray,   # [B]
+) -> jnp.ndarray:
+    """Full-sequence logits for training/scoring: [B, T, V] fp32."""
+    hidden, _, _ = forward_prefill(spec, params, tokens, seq_lens)
+    return unembed(spec, params, hidden)
+
+
+def causal_lm_loss(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B, T]
+    seq_lens: jnp.ndarray,   # [B]
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over valid positions."""
+    logits = forward_train(spec, params, tokens, seq_lens)   # [B, T, V]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    t = tokens.shape[1]
+    valid = (jnp.arange(t - 1)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)

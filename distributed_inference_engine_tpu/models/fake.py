@@ -1,0 +1,83 @@
+"""Fake engine: the real ``Engine`` interface with injectable latency/errors.
+
+Capability heir of the reference's test strategy (SURVEY.md §4): ``FakeModel``
+(configurable latency, metric tracking — ``src/mock_models/fake_model.py:11-83``)
+and ``mock_batch_inference`` (injectable ``error_rate``/``latency_ms`` —
+``src/mock_models/mock_inference.py:31-53``). Every orchestration layer
+(worker, batcher, router, coordinator) is tested on CPU against this class, so
+their tests never need a TPU or a multi-second jit compile.
+
+Semantics: "generation" echoes the prompt reversed, token by token, up to
+``max_new_tokens`` — deterministic, order-sensitive, and cheap, so tests can
+assert exact outputs AND detect batch-order mix-ups (an echo that ignored
+order couldn't).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+from ..engine.engine import GenerationRequest, GenerationResult
+from ..utils.tracing import LatencyStats
+
+
+class FakeEngine:
+    """Drop-in for ``engine.Engine`` with simulated latency and failures."""
+
+    def __init__(
+        self,
+        latency_s: float = 0.0,
+        per_token_latency_s: float = 0.0,
+        error_rate: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        self.latency_s = latency_s
+        self.per_token_latency_s = per_token_latency_s
+        self.error_rate = error_rate
+        self._rand = random.Random(seed)
+        self.prefill_stats = LatencyStats()
+        self.decode_stats = LatencyStats()
+        self._total_requests = 0
+        self._total_generated_tokens = 0
+        self._total_errors = 0
+
+    def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
+        self._total_requests += len(requests)
+        t0 = time.perf_counter()
+        if self.error_rate and self._rand.random() < self.error_rate:
+            self._total_errors += 1
+            raise RuntimeError("injected fake-engine failure")
+        n_tokens = sum(min(len(r.prompt), r.max_new_tokens) for r in requests)
+        delay = self.latency_s + self.per_token_latency_s * n_tokens
+        if delay:
+            time.sleep(delay)
+        results = []
+        for i, r in enumerate(requests):
+            toks = list(reversed(r.prompt))[: r.max_new_tokens]
+            self._total_generated_tokens += len(toks)
+            results.append(
+                GenerationResult(
+                    request_id=r.request_id or f"fake-{self._total_requests}-{i}",
+                    tokens=toks,
+                    finish_reason="length",
+                    prompt_tokens=len(r.prompt),
+                    ttft_s=delay,
+                    decode_s=0.0,
+                    metadata={"fake": True},
+                )
+            )
+        self.prefill_stats.add(time.perf_counter() - t0)
+        return results
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "total_requests": self._total_requests,
+            "total_prompt_tokens": 0,
+            "total_generated_tokens": self._total_generated_tokens,
+            "total_errors": self._total_errors,
+            "prefill": self.prefill_stats.snapshot(),
+            "decode": self.decode_stats.snapshot(),
+            "spec": {"fake": True},
+        }

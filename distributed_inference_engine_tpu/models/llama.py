@@ -1,0 +1,44 @@
+"""Llama family specs (BASELINE.json configs[2-4]: Llama-3-8B TP=8 north star).
+
+Architecture: RoPE, RMSNorm, SwiGLU, grouped-query attention, no biases,
+untied embeddings. Sizes follow the published family ladder; the "-tiny"
+entries are test-scale configs with the same architectural shape, used by the
+CPU test suite and demos.
+"""
+
+from __future__ import annotations
+
+from .base import ModelSpec
+
+_FAMILY = {
+    # name: (layers, d_model, heads, kv_heads, d_ff, vocab, rope_theta, max_seq)
+    "llama3-8b": (32, 4096, 32, 8, 14336, 128256, 500000.0, 8192),
+    "llama3-70b": (80, 8192, 64, 8, 28672, 128256, 500000.0, 8192),
+    "llama2-7b": (32, 4096, 32, 32, 11008, 32000, 10000.0, 4096),
+    "llama-tiny": (4, 256, 8, 4, 688, 1024, 10000.0, 512),
+    "llama-mini": (8, 512, 8, 4, 1376, 32000, 10000.0, 2048),
+}
+
+
+def llama_spec(size: str = "llama3-8b", **overrides) -> ModelSpec:
+    if size not in _FAMILY:
+        raise ValueError(f"unknown llama size {size!r}; choose from {sorted(_FAMILY)}")
+    layers, d_model, heads, kv_heads, d_ff, vocab, theta, max_seq = _FAMILY[size]
+    base = dict(
+        vocab_size=vocab,
+        d_model=d_model,
+        n_layers=layers,
+        n_heads=heads,
+        n_kv_heads=kv_heads,
+        d_ff=d_ff,
+        max_seq_len=max_seq,
+        pos_emb="rope",
+        norm="rmsnorm",
+        mlp="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+        rope_theta=theta,
+        norm_eps=1e-5,
+    )
+    base.update(overrides)
+    return ModelSpec(**base).validate()

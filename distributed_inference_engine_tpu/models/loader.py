@@ -1,0 +1,211 @@
+"""HF safetensors checkpoint loading into the stacked-layer param tree.
+
+Heir of the registry's ``model_path`` field, which the reference never reads
+(no weights exist anywhere in it — SURVEY.md §5 checkpoint/resume row). Here
+``load_checkpoint`` maps a HuggingFace checkpoint directory (GPT-2 or Llama
+naming) onto the stacked ``[n_layers, ...]`` pytree of ``models/base.py``,
+casting to the spec dtype.
+
+Zero-egress environment note: weights must already be on local disk; nothing
+is downloaded. ``save_checkpoint`` writes the same HF naming, so tests can
+fabricate tiny checkpoints and round-trip them.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Dict, Iterator, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ModelSpec, Params
+
+
+def _iter_safetensor_files(path: pathlib.Path) -> Iterator[pathlib.Path]:
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    yield from files
+
+
+def _load_raw(path: pathlib.Path) -> Dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    raw: Dict[str, np.ndarray] = {}
+    for f in _iter_safetensor_files(path):
+        raw.update(load_file(str(f)))
+    return raw
+
+
+def _stack(raw: Dict[str, np.ndarray], template: str, n_layers: int,
+           transpose: bool = False) -> np.ndarray:
+    mats = []
+    for layer in range(n_layers):
+        name = template.format(layer)
+        if name not in raw:
+            raise KeyError(f"checkpoint missing tensor {name}")
+        m = raw[name]
+        mats.append(m.T if transpose else m)
+    return np.stack(mats)
+
+
+# HF GPT-2 Conv1D stores weights as [in, out] (no transpose needed for x @ W);
+# HF Llama nn.Linear stores [out, in] (transpose to our [in, out] layout).
+
+def _map_gpt2(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
+    L, D = spec.n_layers, spec.d_model
+    pre = "" if "wte.weight" in raw else "transformer."
+    qkv = _stack(raw, pre + "h.{}.attn.c_attn.weight", L)       # [L, D, 3D]
+    qkv_b = _stack(raw, pre + "h.{}.attn.c_attn.bias", L)       # [L, 3D]
+    blocks = {
+        "ln1_scale": _stack(raw, pre + "h.{}.ln_1.weight", L),
+        "ln1_bias": _stack(raw, pre + "h.{}.ln_1.bias", L),
+        "ln2_scale": _stack(raw, pre + "h.{}.ln_2.weight", L),
+        "ln2_bias": _stack(raw, pre + "h.{}.ln_2.bias", L),
+        "wq": qkv[:, :, :D],
+        "wk": qkv[:, :, D : 2 * D],
+        "wv": qkv[:, :, 2 * D :],
+        "bq": qkv_b[:, :D],
+        "bk": qkv_b[:, D : 2 * D],
+        "bv": qkv_b[:, 2 * D :],
+        "wo": _stack(raw, pre + "h.{}.attn.c_proj.weight", L),
+        "bo": _stack(raw, pre + "h.{}.attn.c_proj.bias", L),
+        "w_up": _stack(raw, pre + "h.{}.mlp.c_fc.weight", L),
+        "b_up": _stack(raw, pre + "h.{}.mlp.c_fc.bias", L),
+        "w_down": _stack(raw, pre + "h.{}.mlp.c_proj.weight", L),
+        "b_down": _stack(raw, pre + "h.{}.mlp.c_proj.bias", L),
+    }
+    return {
+        "tok_emb": raw[pre + "wte.weight"],
+        "pos_emb": raw[pre + "wpe.weight"],
+        "blocks": blocks,
+        "lnf_scale": raw[pre + "ln_f.weight"],
+        "lnf_bias": raw[pre + "ln_f.bias"],
+    }
+
+
+def _map_llama(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
+    L = spec.n_layers
+    pre = "" if "model.embed_tokens.weight" not in raw else "model."
+    blocks = {
+        "ln1_scale": _stack(raw, pre + "layers.{}.input_layernorm.weight", L),
+        "ln2_scale": _stack(raw, pre + "layers.{}.post_attention_layernorm.weight", L),
+        "wq": _stack(raw, pre + "layers.{}.self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(raw, pre + "layers.{}.self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(raw, pre + "layers.{}.self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(raw, pre + "layers.{}.self_attn.o_proj.weight", L, transpose=True),
+        "w_gate": _stack(raw, pre + "layers.{}.mlp.gate_proj.weight", L, transpose=True),
+        "w_up": _stack(raw, pre + "layers.{}.mlp.up_proj.weight", L, transpose=True),
+        "w_down": _stack(raw, pre + "layers.{}.mlp.down_proj.weight", L, transpose=True),
+    }
+    emb_key = (pre + "embed_tokens.weight") if pre else "embed_tokens.weight"
+    params = {
+        "tok_emb": raw[emb_key],
+        "blocks": blocks,
+        "lnf_scale": raw[pre + "norm.weight"],
+    }
+    if "lm_head.weight" in raw and not spec.tie_embeddings:
+        params["lm_head"] = raw["lm_head.weight"].T
+    elif not spec.tie_embeddings:
+        params["lm_head"] = raw[emb_key].T   # HF tied checkpoints omit lm_head
+    return params
+
+
+def load_checkpoint(path: str, spec: ModelSpec) -> Params:
+    """Load a local HF checkpoint dir into the stacked param tree, cast to
+    ``spec.dtype``."""
+    p = pathlib.Path(path)
+    raw = _load_raw(p)
+    if any(k.endswith("wte.weight") for k in raw):
+        tree = _map_gpt2(raw, spec)
+    elif any(k.endswith("embed_tokens.weight") for k in raw):
+        tree = _map_llama(raw, spec)
+    else:
+        raise ValueError(f"unrecognized checkpoint naming in {path}")
+    dt = spec.jnp_dtype
+
+    def cast(x):
+        a = np.asarray(x)
+        if a.dtype == np.uint16:   # bf16 tensors surfaced as raw bit patterns
+            import ml_dtypes
+
+            a = a.view(ml_dtypes.bfloat16)
+        return jnp.asarray(a).astype(dt)
+
+    import jax
+
+    return jax.tree.map(cast, tree)
+
+
+def spec_from_hf_config(path: str) -> ModelSpec:
+    """Build a ModelSpec from a HF ``config.json``."""
+    cfg = json.loads((pathlib.Path(path) / "config.json").read_text())
+    arch = (cfg.get("architectures") or [""])[0].lower()
+    if "gpt2" in arch or cfg.get("model_type") == "gpt2":
+        return ModelSpec(
+            vocab_size=cfg["vocab_size"],
+            d_model=cfg["n_embd"],
+            n_layers=cfg["n_layer"],
+            n_heads=cfg["n_head"],
+            n_kv_heads=cfg["n_head"],
+            d_ff=4 * cfg["n_embd"],
+            max_seq_len=cfg.get("n_positions", 1024),
+            pos_emb="learned",
+            norm="layernorm",
+            mlp="gelu",
+            use_bias=True,
+            tie_embeddings=True,
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+        ).validate()
+    if "llama" in arch or cfg.get("model_type") == "llama":
+        return ModelSpec(
+            vocab_size=cfg["vocab_size"],
+            d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"],
+            n_heads=cfg["num_attention_heads"],
+            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            d_ff=cfg["intermediate_size"],
+            max_seq_len=cfg.get("max_position_embeddings", 4096),
+            pos_emb="rope",
+            norm="rmsnorm",
+            mlp="swiglu",
+            use_bias=False,
+            tie_embeddings=cfg.get("tie_word_embeddings", False),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        ).validate()
+    raise ValueError(f"unsupported architecture in {path}: {arch}")
+
+
+def save_checkpoint_gpt2(path: str, params: Params, spec: ModelSpec) -> None:
+    """Write params back out in HF GPT-2 naming (test fixture / export)."""
+    from safetensors.numpy import save_file
+
+    b = params["blocks"]
+    L, D = spec.n_layers, spec.d_model
+    raw: Dict[str, np.ndarray] = {
+        "wte.weight": np.asarray(params["tok_emb"], dtype=np.float32),
+        "wpe.weight": np.asarray(params["pos_emb"], dtype=np.float32),
+        "ln_f.weight": np.asarray(params["lnf_scale"], dtype=np.float32),
+        "ln_f.bias": np.asarray(params["lnf_bias"], dtype=np.float32),
+    }
+    qkv = np.concatenate(
+        [np.asarray(b["wq"]), np.asarray(b["wk"]), np.asarray(b["wv"])], axis=-1
+    ).astype(np.float32)
+    qkv_b = np.concatenate(
+        [np.asarray(b["bq"]), np.asarray(b["bk"]), np.asarray(b["bv"])], axis=-1
+    ).astype(np.float32)
+    for l in range(L):
+        raw[f"h.{l}.attn.c_attn.weight"] = qkv[l]
+        raw[f"h.{l}.attn.c_attn.bias"] = qkv_b[l]
+        for ours, theirs in (
+            ("ln1_scale", "ln_1.weight"), ("ln1_bias", "ln_1.bias"),
+            ("ln2_scale", "ln_2.weight"), ("ln2_bias", "ln_2.bias"),
+            ("wo", "attn.c_proj.weight"), ("bo", "attn.c_proj.bias"),
+            ("w_up", "mlp.c_fc.weight"), ("b_up", "mlp.c_fc.bias"),
+            ("w_down", "mlp.c_proj.weight"), ("b_down", "mlp.c_proj.bias"),
+        ):
+            raw[f"h.{l}.{theirs}"] = np.asarray(b[ours][l], dtype=np.float32)
+    save_file(raw, str(pathlib.Path(path) / "model.safetensors"))

@@ -1,0 +1,4 @@
+from .norms import layer_norm, rms_norm  # noqa: F401
+from .rope import apply_rope, rope_freqs  # noqa: F401
+from .attention import causal_attention, cached_attention  # noqa: F401
+from .sampling import sample_tokens, SamplingParams  # noqa: F401

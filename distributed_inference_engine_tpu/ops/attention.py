@@ -1,0 +1,82 @@
+"""Attention ops for prefill and decode, XLA-native.
+
+Replaces the reference's compute kernel — an ``asyncio.sleep``
+(``src/mock_models/fake_model.py:47``) — with the real thing. Two entry
+points matching the two serving phases:
+
+- ``causal_attention``: prefill over the freshly computed K/V of the prompt
+  (no history exists yet, so attending over the full cache would waste
+  HBM bandwidth reading empty pages).
+- ``cached_attention``: decode, one query token per slot against the
+  HBM-resident KV cache, masked by each slot's live length.
+
+Both are pure einsum/softmax chains: XLA fuses mask+softmax+matmul well on
+the MXU for these shapes. The Pallas paged-attention kernel
+(``ops/paged_attention.py``) takes over when the cache is paged.
+
+GQA layout note: K/V carry ``n_kv_heads``; queries carry ``n_heads``. We
+reshape Q to [B, T, n_kv, group, Dh] and broadcast K/V across the group dim —
+no materialized repeat, XLA keeps it as an indexing pattern.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30   # large-but-finite: -inf rows would softmax to NaN
+
+
+def _group_query(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
+    """[B, T, H, Dh] -> [B, T, Hkv, G, Dh] where H = Hkv * G."""
+    b, t, h, d = q.shape
+    return q.reshape(b, t, n_kv_heads, h // n_kv_heads, d)
+
+
+def causal_attention(
+    q: jnp.ndarray,          # [B, T, H, Dh]
+    k: jnp.ndarray,          # [B, T, Hkv, Dh]
+    v: jnp.ndarray,          # [B, T, Hkv, Dh]
+    seq_lens: jnp.ndarray,   # [B] valid prompt lengths (right-padded batches)
+) -> jnp.ndarray:
+    """Prefill attention: causal within the prompt, padding masked out.
+
+    Returns [B, T, H, Dh].
+    """
+    b, t, h, dh = q.shape
+    n_kv = k.shape[2]
+    qg = _group_query(q, n_kv)                                   # [B,T,Hkv,G,Dh]
+    scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+    # scores: [B, Hkv, G, T, T]
+    scores = jnp.einsum("bikgd,bjkd->bkgij", qg, k).astype(jnp.float32) * scale
+    i = jnp.arange(t)[:, None]
+    j = jnp.arange(t)[None, :]
+    causal = j <= i                                              # [T, T]
+    valid = jnp.arange(t)[None, :] < seq_lens[:, None]           # [B, T] keys in-prompt
+    mask = causal[None, :, :] & valid[:, None, :]                # [B, T, T]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgij,bjkd->bikgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, h, dh)
+
+
+def cached_attention(
+    q: jnp.ndarray,          # [B, 1, H, Dh] decode queries
+    cache_k: jnp.ndarray,    # [B, S, Hkv, Dh] full HBM cache rows
+    cache_v: jnp.ndarray,    # [B, S, Hkv, Dh]
+    lengths: jnp.ndarray,    # [B] live length per slot (incl. the new token)
+) -> jnp.ndarray:
+    """Decode attention against the KV cache, masked to each slot's live
+    prefix. Returns [B, 1, H, Dh]."""
+    b, t, h, dh = q.shape
+    s = cache_k.shape[1]
+    n_kv = cache_k.shape[2]
+    qg = _group_query(q, n_kv)                                   # [B,1,Hkv,G,Dh]
+    scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+    scores = jnp.einsum("bikgd,bjkd->bkgij", qg, cache_k).astype(jnp.float32) * scale
+    valid = jnp.arange(s)[None, :] < lengths[:, None]            # [B, S]
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgij,bjkd->bikgd", probs.astype(cache_v.dtype), cache_v)
+    return out.reshape(b, t, h, dh)

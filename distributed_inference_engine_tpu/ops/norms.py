@@ -1,0 +1,29 @@
+"""Normalization primitives.
+
+fp32 accumulation regardless of activation dtype: on TPU the VPU does the
+reductions; keeping them in fp32 costs nothing measurable and avoids bf16
+variance underflow. XLA fuses the normalize-scale-shift chain into the
+surrounding matmul's epilogue, so these stay simple jnp expressions — no
+Pallas needed here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """GPT-2-style LayerNorm over the trailing (model) dim."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """Llama-style RMSNorm (no mean subtraction, no bias)."""
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * (1.0 / jnp.sqrt(ms + eps))
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)

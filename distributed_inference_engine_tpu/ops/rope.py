@@ -1,0 +1,39 @@
+"""Rotary position embeddings (RoPE), as used by the Llama family.
+
+Position indices arrive as an explicit array (shape [B] or [B, T]) rather than
+being derived from the sequence axis: under continuous batching every slot sits
+at a different absolute position, and under sequence parallelism each shard
+owns a different slice of positions — both just change the index array, not
+the op. Everything here is static-shape and jit/scan-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies for each head-dim pair: [head_dim // 2], fp32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(
+    x: jnp.ndarray,          # [B, T, H, Dh]
+    positions: jnp.ndarray,  # [B, T] absolute token positions
+    theta: float = 10000.0,
+) -> jnp.ndarray:
+    """Rotate query/key vectors by their absolute position.
+
+    Uses the split-halves convention (first half / second half pairing), the
+    same layout HF Llama checkpoints are trained with, so loaded weights work
+    unmodified.
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_freqs(head_dim, theta)                      # [Dh/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, Dh/2]
+    cos = jnp.cos(angles)[:, :, None, :]                     # [B, T, 1, Dh/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

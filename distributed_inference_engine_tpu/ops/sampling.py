@@ -1,0 +1,73 @@
+"""Token sampling: greedy, temperature, top-k, top-p — all static-shape and
+jit/scan-safe so the whole decode loop stays on-device.
+
+The knobs are carried in a ``SamplingParams`` pytree of arrays (not Python
+scalars), so one compiled decode program serves every request mix: greedy is
+temperature==0, top-k off is k==vocab, top-p off is p==1. No recompilation
+when a request changes its sampling settings.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot sampling knobs, each [B] fp32/int32 arrays."""
+
+    temperature: jnp.ndarray   # 0.0 => greedy
+    top_k: jnp.ndarray         # 0 or >= vocab => disabled
+    top_p: jnp.ndarray         # 1.0 => disabled
+
+    @classmethod
+    def make(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0) -> "SamplingParams":
+        full = lambda v, dt: jnp.full((batch,), v, dtype=dt)
+        return cls(full(temperature, jnp.float32), full(top_k, jnp.int32),
+                   full(top_p, jnp.float32))
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] fp32
+    params: SamplingParams,
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Sample one token per row. Returns [B] int32.
+
+    Strategy composition: temperature scales, then top-k and top-p masks
+    (applied on the sorted distribution, so both are O(V log V) sorts that XLA
+    does fine on-device), then a Gumbel-max draw — which avoids materializing a
+    renormalized distribution. Greedy rows (temperature 0) take an argmax on
+    the *masked* logits, so greedy + top-k interact correctly.
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    # ---- top-k mask: keep the k highest logits per row
+    k = jnp.where(params.top_k <= 0, v, params.top_k)            # [B]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]             # [B, V]
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1
+    )                                                            # [B, 1]
+    keep_topk = logits >= kth
+
+    # ---- top-p (nucleus) mask: smallest prefix of sorted probs covering p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # token ranks: position of each logit in the descending sort
+    ranks = jnp.argsort(jnp.argsort(-logits, axis=-1), axis=-1)  # [B, V]
+    # keep ranks whose cumulative prob (exclusive) is < p  => always keeps rank 0
+    cum_excl = cum - probs_sorted
+    keep_sorted = cum_excl < params.top_p[:, None]
+    keep_topp = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+
+    masked = jnp.where(keep_topk & keep_topp, logits, -jnp.inf)
+
+    # ---- temperature + Gumbel-max
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    gumbel = -jnp.log(-jnp.log(jax.random.uniform(key, (b, v), minval=1e-20, maxval=1.0)))
+    stochastic = jnp.argmax(masked / temp + gumbel, axis=-1)
+    greedy = jnp.argmax(masked, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)

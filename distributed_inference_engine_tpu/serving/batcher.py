@@ -138,18 +138,20 @@ class Batcher:
             raise RuntimeError("batcher is not running")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        req = BatchedRequest(
-            request_id=request_id or f"req-{self._total_requests}",
-            inputs=inputs,
-            future=fut,
-            trace=trace,
-        )
-        if trace is not None:
-            trace.mark("queued")
         key = (model, version)
-        flush_now = False
+        full_batch: Optional[Batch] = None
+        timer: Optional[asyncio.Task] = None
         async with self._lock:
+            # id minted under the lock so concurrent adds can't collide
             self._total_requests += 1
+            req = BatchedRequest(
+                request_id=request_id or f"req-{self._total_requests}",
+                inputs=inputs,
+                future=fut,
+                trace=trace,
+            )
+            if trace is not None:
+                trace.mark("queued")
             batch = self._pending.get(key)
             if batch is None:
                 batch = Batch(model=model, version=version)
@@ -157,9 +159,14 @@ class Batcher:
                 self._timers[key] = asyncio.ensure_future(self._latency_timer(key))
             batch.requests.append(req)
             if len(batch.requests) >= self.max_batch_size:
-                flush_now = True
-        if flush_now:
-            await self._flush(key, reason="size")
+                # detach the full batch HERE, not after re-acquiring the lock —
+                # a lock-waiting add could otherwise grow it past max_batch_size
+                full_batch = self._pending.pop(key)
+                timer = self._timers.pop(key, None)
+        if full_batch is not None:
+            if timer is not None and not timer.done():
+                timer.cancel()
+            self._dispatch(full_batch, reason="size")
         return fut
 
     # ------------------------------------------------------------- flushing
@@ -173,7 +180,8 @@ class Batcher:
             pass
 
     async def _flush(self, key: Tuple[str, str], reason: str) -> None:
-        """Detach the pending batch under the lock, dispatch outside it."""
+        """Detach the pending batch under the lock, dispatch outside it
+        (timer and drain paths; the size path detaches in add_request)."""
         async with self._lock:
             batch = self._pending.pop(key, None)
             timer = self._timers.pop(key, None)
@@ -181,6 +189,9 @@ class Batcher:
             timer.cancel()
         if batch is None or not batch.requests:
             return
+        self._dispatch(batch, reason)
+
+    def _dispatch(self, batch: Batch, reason: str) -> None:
         self._total_batches += 1
         self._total_batched_requests += len(batch.requests)
         self._batch_size_sum += len(batch.requests)

@@ -172,3 +172,39 @@ def test_ctor_validation():
 def test_empty_bucket_sizes_means_no_buckets():
     b = Batcher(RecordingBackend(), max_batch_size=4, bucket_sizes=[])
     assert b.bucket_sizes is None
+
+
+@pytest.mark.asyncio
+async def test_no_batch_exceeds_max_size_under_concurrency():
+    """Code-review regression: concurrent adds must never grow a detached
+    batch past max_batch_size, and request ids must stay unique."""
+    be = RecordingBackend(latency_s=0.001)
+    b = Batcher(be, max_batch_size=3, max_latency_ms=5)
+    await b.start()
+    futs = await asyncio.gather(*(
+        asyncio.create_task(b.add_request("m", "1", i)) for i in range(50)
+    ))
+    await asyncio.gather(*futs)
+    await b.stop()
+    assert all(len(c[2]) <= 3 for c in be.calls)
+    assert sum(len(c[2]) for c in be.calls) == 50
+
+
+@pytest.mark.asyncio
+async def test_request_ids_unique_under_concurrency():
+    ids = []
+
+    async def backend(model, version, inputs):
+        return [1] * len(inputs)
+
+    b = Batcher(backend, max_batch_size=4, max_latency_ms=5)
+    await b.start()
+
+    async def add(i):
+        fut = await b.add_request("m", "1", i)
+        await fut
+
+    await asyncio.gather(*(add(i) for i in range(40)))
+    await b.stop()
+    # ids are minted under the lock from the monotonic counter
+    assert b.get_stats()["total_requests"] == 40

@@ -1,0 +1,161 @@
+"""Engine tests: generation semantics on a tiny model (CPU)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import EngineConfig
+from distributed_inference_engine_tpu.engine.engine import (
+    Engine,
+    GenerationRequest,
+    GenerationResult,
+    _next_bucket,
+    _pow2_buckets,
+)
+from distributed_inference_engine_tpu.engine.kv_cache import SlotKVCache
+from distributed_inference_engine_tpu.models.base import ModelSpec
+from distributed_inference_engine_tpu.models.fake import FakeEngine
+
+SPEC = ModelSpec(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=48,
+    max_seq_len=128, pos_emb="rope", norm="rmsnorm", mlp="swiglu",
+    use_bias=False, tie_embeddings=False, dtype="float32",
+)
+CFG = EngineConfig(
+    max_seq_len=128, max_slots=4, prefill_buckets=[16, 32],
+    decode_steps_per_call=4, dtype="float32", kv_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return Engine(SPEC, config=CFG, seed=0)
+
+
+def test_bucket_helpers():
+    assert _pow2_buckets(8) == [1, 2, 4, 8]
+    assert _pow2_buckets(6) == [1, 2, 4, 6]
+    assert _next_bucket(3, [2, 4, 8]) == 4
+    with pytest.raises(ValueError):
+        _next_bucket(9, [2, 4, 8])
+
+
+def test_greedy_generation_is_deterministic(engine):
+    req = GenerationRequest(prompt=[1, 2, 3], max_new_tokens=8)
+    r1 = engine.generate([req])[0]
+    r2 = engine.generate([req])[0]
+    assert r1.tokens == r2.tokens
+    assert len(r1.tokens) == 8
+    assert r1.finish_reason == "length"
+    assert all(0 <= t < SPEC.vocab_size for t in r1.tokens)
+
+
+def test_batch_matches_solo_greedy(engine):
+    """Continuous-batching prerequisite: a request's output must not depend on
+    its batch neighbors or on padding slots."""
+    a = GenerationRequest(prompt=[5, 6, 7, 8], max_new_tokens=6)
+    b = GenerationRequest(prompt=[9, 10], max_new_tokens=6)
+    c = GenerationRequest(prompt=[11], max_new_tokens=6)
+    solo = engine.generate([a])[0].tokens
+    batched = engine.generate([a, b, c])
+    assert batched[0].tokens == solo
+    assert len(batched[1].tokens) == 6
+    assert len(batched[2].tokens) == 6
+
+
+def test_max_new_tokens_respected_per_request(engine):
+    rs = engine.generate([
+        GenerationRequest(prompt=[1, 2], max_new_tokens=2),
+        GenerationRequest(prompt=[3, 4], max_new_tokens=7),
+    ])
+    assert len(rs[0].tokens) == 2
+    assert len(rs[1].tokens) == 7
+
+
+def test_eos_stops_generation(engine):
+    # discover greedy continuation, then set eos to its second token
+    probe = engine.generate([GenerationRequest(prompt=[2, 3], max_new_tokens=6)])[0]
+    eos = probe.tokens[1]
+    out = engine.generate(
+        [GenerationRequest(prompt=[2, 3], max_new_tokens=6, eos_id=eos)]
+    )[0]
+    assert out.tokens == probe.tokens[:2]
+    assert out.finish_reason == "stop"
+
+
+def test_sampled_generation_varies_but_is_seeded(engine):
+    req = GenerationRequest(prompt=[1], max_new_tokens=12, temperature=1.0, top_p=0.95)
+    outs = {tuple(engine.generate([req])[0].tokens) for _ in range(4)}
+    assert len(outs) > 1      # rng state advances between calls
+
+
+def test_empty_and_overlong_prompts(engine):
+    with pytest.raises(ValueError):
+        engine.generate([GenerationRequest(prompt=[], max_new_tokens=2)])
+    long_prompt = list(np.random.RandomState(0).randint(0, 64, size=100))
+    r = engine.generate([GenerationRequest(prompt=long_prompt, max_new_tokens=3)])[0]
+    assert len(r.tokens) == 3   # clamped to bucket tail, still generates
+
+
+def test_metrics_accumulate(engine):
+    m0 = engine.get_metrics()
+    engine.generate([GenerationRequest(prompt=[1, 2], max_new_tokens=2)])
+    m1 = engine.get_metrics()
+    assert m1["total_requests"] == m0["total_requests"] + 1
+    assert m1["total_generated_tokens"] >= m0["total_generated_tokens"] + 2
+    assert m1["prefill"]["count"] > 0
+
+
+# ------------------------------------------------------------------ KV cache
+
+
+def test_slot_kv_cache_alloc_free():
+    cache = SlotKVCache(SPEC, max_slots=2, max_seq_len=16)
+    s0 = cache.alloc("r0")
+    s1 = cache.alloc("r1")
+    assert {s0, s1} == {0, 1}
+    assert cache.alloc("r2") is None        # full
+    cache.free(s0)
+    assert cache.alloc("r3") == s0
+    stats = cache.get_stats()
+    assert stats["live_slots"] == 2 and stats["hbm_bytes"] > 0
+    cache.reset()
+    assert cache.n_free == 2
+
+
+# ---------------------------------------------------------------- fake engine
+
+
+def test_fake_engine_echo_and_interface():
+    fe = FakeEngine(latency_s=0.0)
+    rs = fe.generate([
+        GenerationRequest(prompt=[1, 2, 3], max_new_tokens=2, request_id="x"),
+        GenerationRequest(prompt=[4], max_new_tokens=5),
+    ])
+    assert rs[0].tokens == [3, 2]           # reversed prompt, capped
+    assert rs[0].request_id == "x"
+    assert rs[1].tokens == [4]
+    m = fe.get_metrics()
+    assert m["total_requests"] == 2
+    assert isinstance(rs[0], GenerationResult)
+
+
+def test_fake_engine_error_injection():
+    fe = FakeEngine(error_rate=1.0)
+    with pytest.raises(RuntimeError):
+        fe.generate([GenerationRequest(prompt=[1])])
+    assert fe.get_metrics()["total_errors"] == 1
+
+
+def test_seq_cap_uses_engine_config_not_spec():
+    """Code-review regression: spec.max_seq_len > config.max_seq_len must not
+    crash bucket lookup; the request clamps to the engine's configured cap."""
+    spec_big = ModelSpec(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=48,
+        max_seq_len=4096, dtype="float32",
+    )
+    cfg = EngineConfig(max_seq_len=64, max_slots=2, prefill_buckets=[16],
+                       dtype="float32", kv_dtype="float32", decode_steps_per_call=2)
+    eng = Engine(spec_big, config=cfg, seed=0)
+    r = eng.generate([GenerationRequest(prompt=[1, 2, 3], max_new_tokens=500)])[0]
+    assert 1 <= len(r.tokens) <= 64

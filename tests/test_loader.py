@@ -1,0 +1,105 @@
+"""Checkpoint loader tests: fabricate a tiny HF-named checkpoint on disk and
+round-trip it (zero-egress environment — no downloads, SURVEY.md §5
+checkpoint row)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_engine_tpu.models.base import ModelSpec, init_params, forward_train
+from distributed_inference_engine_tpu.models.loader import (
+    load_checkpoint,
+    save_checkpoint_gpt2,
+    spec_from_hf_config,
+)
+
+TINY_GPT2 = ModelSpec(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4, d_ff=64,
+    max_seq_len=32, pos_emb="learned", norm="layernorm", mlp="gelu",
+    use_bias=True, tie_embeddings=True, dtype="float32",
+)
+
+
+def test_gpt2_round_trip(tmp_path):
+    params = init_params(TINY_GPT2, jax.random.key(0))
+    save_checkpoint_gpt2(str(tmp_path), params, TINY_GPT2)
+    loaded = load_checkpoint(str(tmp_path), TINY_GPT2)
+    # same tree structure, same values
+    flat1 = jax.tree.leaves_with_path(params)
+    flat2 = jax.tree.leaves_with_path(loaded)
+    assert len(flat1) == len(flat2)
+    for (p1, a1), (p2, a2) in zip(sorted(flat1, key=lambda x: str(x[0])),
+                                  sorted(flat2, key=lambda x: str(x[0]))):
+        assert str(p1) == str(p2)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), rtol=1e-6)
+    # and the loaded params compute identical logits
+    toks = jnp.asarray([[1, 2, 3, 4]], dtype=jnp.int32)
+    l1 = forward_train(TINY_GPT2, params, toks, jnp.array([4]))
+    l2 = forward_train(TINY_GPT2, loaded, toks, jnp.array([4]))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-5)
+
+
+def test_llama_mapping(tmp_path):
+    """Fabricate HF-Llama-named tensors, check transpose + stacking."""
+    from safetensors.numpy import save_file
+
+    spec = ModelSpec(
+        vocab_size=32, d_model=16, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=24,
+        max_seq_len=32, pos_emb="rope", norm="rmsnorm", mlp="swiglu",
+        use_bias=False, tie_embeddings=False, dtype="float32",
+    )
+    rs = np.random.RandomState(0)
+    D, F, V = spec.d_model, spec.d_ff, spec.vocab_size
+    Hd, Kd = spec.n_heads * spec.head_dim, spec.n_kv_heads * spec.head_dim
+    raw = {
+        "model.embed_tokens.weight": rs.randn(V, D).astype(np.float32),
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": rs.randn(V, D).astype(np.float32),
+    }
+    for l in range(2):
+        raw[f"model.layers.{l}.input_layernorm.weight"] = np.ones(D, np.float32)
+        raw[f"model.layers.{l}.post_attention_layernorm.weight"] = np.ones(D, np.float32)
+        raw[f"model.layers.{l}.self_attn.q_proj.weight"] = rs.randn(Hd, D).astype(np.float32)
+        raw[f"model.layers.{l}.self_attn.k_proj.weight"] = rs.randn(Kd, D).astype(np.float32)
+        raw[f"model.layers.{l}.self_attn.v_proj.weight"] = rs.randn(Kd, D).astype(np.float32)
+        raw[f"model.layers.{l}.self_attn.o_proj.weight"] = rs.randn(D, Hd).astype(np.float32)
+        raw[f"model.layers.{l}.mlp.gate_proj.weight"] = rs.randn(F, D).astype(np.float32)
+        raw[f"model.layers.{l}.mlp.up_proj.weight"] = rs.randn(F, D).astype(np.float32)
+        raw[f"model.layers.{l}.mlp.down_proj.weight"] = rs.randn(D, F).astype(np.float32)
+    save_file(raw, str(tmp_path / "model.safetensors"))
+
+    params = load_checkpoint(str(tmp_path), spec)
+    assert params["blocks"]["wq"].shape == (2, D, Hd)       # stacked + transposed
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["wq"][1]),
+        raw["model.layers.1.self_attn.q_proj.weight"].T,
+        rtol=1e-6,
+    )
+    assert params["lm_head"].shape == (D, V)
+    # loaded tree must run
+    logits = forward_train(spec, params, jnp.asarray([[1, 2, 3]]), jnp.array([3]))
+    assert logits.shape == (1, 3, V)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_spec_from_hf_config(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 128256, "hidden_size": 4096, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "intermediate_size": 14336, "max_position_embeddings": 8192,
+        "rope_theta": 500000.0, "rms_norm_eps": 1e-5,
+    }))
+    spec = spec_from_hf_config(str(tmp_path))
+    assert spec.n_kv_heads == 8 and spec.rope_theta == 500000.0
+    assert spec.mlp == "swiglu" and spec.pos_emb == "rope"
+
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
+        "vocab_size": 50257, "n_embd": 768, "n_layer": 12, "n_head": 12,
+        "n_positions": 1024,
+    }))
+    spec = spec_from_hf_config(str(tmp_path))
+    assert spec.tie_embeddings and spec.use_bias and spec.norm == "layernorm"

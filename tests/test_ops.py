@@ -1,0 +1,147 @@
+"""Numeric tests for the TPU compute ops (run on CPU via conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.ops.norms import layer_norm, rms_norm
+from distributed_inference_engine_tpu.ops.rope import apply_rope
+from distributed_inference_engine_tpu.ops.attention import causal_attention, cached_attention
+from distributed_inference_engine_tpu.ops.sampling import SamplingParams, sample_tokens
+
+
+def test_layer_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(2, 3, 8).astype(np.float32)
+    scale = np.random.RandomState(1).rand(8).astype(np.float32)
+    bias = np.random.RandomState(2).rand(8).astype(np.float32)
+    got = layer_norm(jnp.asarray(x), jnp.asarray(scale), jnp.asarray(bias))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    scale = np.ones(8, dtype=np.float32) * 2
+    got = rms_norm(jnp.asarray(x), jnp.asarray(scale))
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * 2
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_rope_identity_at_position_zero():
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 1, 2, 8).astype(np.float32))
+    pos = jnp.zeros((1, 1), dtype=jnp.int32)
+    np.testing.assert_allclose(np.asarray(apply_rope(x, pos)), np.asarray(x), atol=1e-6)
+
+
+def test_rope_preserves_norm_and_relative_positions():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(1, 4, 1, 16).astype(np.float32))
+    pos = jnp.arange(4)[None, :]
+    r = apply_rope(x, pos)
+    # rotation preserves vector norm
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # dot(q_i, k_j) depends only on i - j: shift both positions by a constant
+    q = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 1, 1, 16).astype(np.float32))
+    def dot_at(pq, pk):
+        qq = apply_rope(q, jnp.array([[pq]]))
+        kk = apply_rope(k, jnp.array([[pk]]))
+        return float(jnp.sum(qq * kk))
+    assert dot_at(5, 3) == pytest.approx(dot_at(9, 7), rel=1e-4)
+
+
+def test_causal_attention_masks_future_and_padding():
+    rs = np.random.RandomState(0)
+    b, t, h, dh = 1, 4, 2, 8
+    q = jnp.asarray(rs.randn(b, t, h, dh).astype(np.float32))
+    k = jnp.asarray(rs.randn(b, t, h, dh).astype(np.float32))
+    v = jnp.asarray(rs.randn(b, t, h, dh).astype(np.float32))
+    out_full = causal_attention(q, k, v, jnp.array([3]))
+    # position 0 attends only to key 0 => its output is v[0]
+    np.testing.assert_allclose(
+        np.asarray(out_full[0, 0]), np.asarray(v[0, 0]), rtol=1e-4, atol=1e-5
+    )
+    # changing the padded key (index 3) must not change any output at pos < 3
+    k2 = k.at[0, 3].set(99.0)
+    v2 = v.at[0, 3].set(99.0)
+    out2 = causal_attention(q, k2, v2, jnp.array([3]))
+    np.testing.assert_allclose(
+        np.asarray(out_full[0, :3]), np.asarray(out2[0, :3]), rtol=1e-5
+    )
+
+
+def test_cached_attention_respects_lengths():
+    rs = np.random.RandomState(1)
+    b, s, h, dh = 2, 8, 2, 4
+    q = jnp.asarray(rs.randn(b, 1, h, dh).astype(np.float32))
+    ck = jnp.asarray(rs.randn(b, s, h, dh).astype(np.float32))
+    cv = jnp.asarray(rs.randn(b, s, h, dh).astype(np.float32))
+    lengths = jnp.array([3, 5])
+    out = cached_attention(q, ck, cv, lengths)
+    # poisoning cache beyond the live prefix must not change outputs
+    ck2 = ck.at[:, 6:].set(1e4)
+    cv2 = cv.at[:, 6:].set(1e4)
+    out2 = cached_attention(q, ck2, cv2, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5)
+
+
+def test_gqa_grouping_matches_repeated_heads():
+    """GQA with Hkv=1 must equal MHA where the single KV head is broadcast."""
+    rs = np.random.RandomState(2)
+    b, t, h, dh = 1, 3, 4, 8
+    q = jnp.asarray(rs.randn(b, t, h, dh).astype(np.float32))
+    k1 = jnp.asarray(rs.randn(b, t, 1, dh).astype(np.float32))
+    v1 = jnp.asarray(rs.randn(b, t, 1, dh).astype(np.float32))
+    out_gqa = causal_attention(q, k1, v1, jnp.array([t]))
+    out_mha = causal_attention(
+        q, jnp.tile(k1, (1, 1, h, 1)), jnp.tile(v1, (1, 1, h, 1)), jnp.array([t])
+    )
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------------ sampling
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 2.0, -1.0], [3.0, 0.0, 0.5]])
+    p = SamplingParams.make(2, temperature=0.0)
+    toks = sample_tokens(logits, p, jax.random.key(0))
+    assert toks.tolist() == [1, 0]
+
+
+def test_top_k_one_is_argmax_even_with_temperature():
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 16).astype(np.float32))
+    p = SamplingParams.make(4, temperature=5.0, top_k=1)
+    for seed in range(3):
+        toks = sample_tokens(logits, p, jax.random.key(seed))
+        assert toks.tolist() == np.argmax(np.asarray(logits), -1).tolist()
+
+
+def test_top_p_excludes_tail():
+    # one dominant token (p=0.9+); top_p=0.5 must always pick it
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])
+    p = SamplingParams.make(1, temperature=1.0, top_p=0.5)
+    for seed in range(5):
+        assert sample_tokens(logits, p, jax.random.key(seed)).tolist() == [0]
+
+
+def test_sampling_is_deterministic_per_key():
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, 32).astype(np.float32))
+    p = SamplingParams.make(2, temperature=1.0, top_k=8, top_p=0.9)
+    a = sample_tokens(logits, p, jax.random.key(7))
+    b = sample_tokens(logits, p, jax.random.key(7))
+    assert a.tolist() == b.tolist()
+
+
+def test_temperature_spreads_choices():
+    logits = jnp.asarray(np.zeros((1, 8), dtype=np.float32))
+    p = SamplingParams.make(1, temperature=1.0)
+    seen = {sample_tokens(logits, p, jax.random.key(s)).tolist()[0] for s in range(20)}
+    assert len(seen) > 1          # uniform logits at temp 1 should vary
