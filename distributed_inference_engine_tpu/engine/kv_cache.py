@@ -19,6 +19,7 @@ arrays and host-side slot accounting.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -108,7 +109,7 @@ class SlotKVCache:
         }
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _write_rows(cache, fresh, slots):
     # cache [L, N, S, H, D], fresh [L, B, T, H, D], slots [B]; T is static
     # under jit (taken from fresh's shape), so this lowers to one scatter.

@@ -14,11 +14,14 @@ from .fake import FakeEngine  # noqa: F401
 
 
 def build_engine(architecture: str, **kwargs):
-    """Engine factory keyed by ``ModelConfig.architecture``."""
+    """Engine factory keyed by ``ModelConfig.architecture``.
+
+    Accepts the union of fake-engine and real-engine knobs and routes each
+    branch only what it understands, so one config-driven call site works
+    across architectures."""
+    fake_keys = ("latency_s", "per_token_latency_s", "error_rate", "seed")
     if architecture == "fake":
-        return FakeEngine(**{k: v for k, v in kwargs.items()
-                             if k in ("latency_s", "per_token_latency_s",
-                                      "error_rate", "seed")})
+        return FakeEngine(**{k: v for k, v in kwargs.items() if k in fake_keys})
     from ..engine.engine import Engine
 
     if architecture.startswith("gpt2"):
@@ -28,4 +31,5 @@ def build_engine(architecture: str, **kwargs):
         spec = llama_spec(architecture if "-" in architecture else "llama3-8b")
     else:
         raise ValueError(f"unknown architecture {architecture!r}")
-    return Engine(spec, **kwargs)
+    real_keys = ("params", "config", "seed", "shard_fn")
+    return Engine(spec, **{k: v for k, v in kwargs.items() if k in real_keys})

@@ -45,29 +45,35 @@ def sample_tokens(
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
 
-    # ---- top-k mask: keep the k highest logits per row
+    # ---- temperature FIRST (HF semantics): nucleus membership is judged on
+    # the tempered distribution, so high temperature widens the nucleus
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # ---- top-k mask: keep the k highest (temperature preserves order, so
+    # this is identical on raw or scaled logits)
     k = jnp.where(params.top_k <= 0, v, params.top_k)            # [B]
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]             # [B, V]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]             # [B, V]
     kth = jnp.take_along_axis(
         sorted_desc, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1
     )                                                            # [B, 1]
-    keep_topk = logits >= kth
+    keep_topk = scaled >= kth
 
-    # ---- top-p (nucleus) mask: smallest prefix of sorted probs covering p
+    # ---- top-p (nucleus) mask: smallest prefix of sorted tempered probs
+    # covering p
     probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
     # token ranks: position of each logit in the descending sort
-    ranks = jnp.argsort(jnp.argsort(-logits, axis=-1), axis=-1)  # [B, V]
+    ranks = jnp.argsort(jnp.argsort(-scaled, axis=-1), axis=-1)  # [B, V]
     # keep ranks whose cumulative prob (exclusive) is < p  => always keeps rank 0
     cum_excl = cum - probs_sorted
     keep_sorted = cum_excl < params.top_p[:, None]
     keep_topp = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
 
-    masked = jnp.where(keep_topk & keep_topp, logits, -jnp.inf)
+    masked = jnp.where(keep_topk & keep_topp, scaled, -jnp.inf)
 
-    # ---- temperature + Gumbel-max
-    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    # ---- Gumbel-max draw on the masked tempered logits
     gumbel = -jnp.log(-jnp.log(jax.random.uniform(key, (b, v), minval=1e-20, maxval=1.0)))
-    stochastic = jnp.argmax(masked / temp + gumbel, axis=-1)
+    stochastic = jnp.argmax(masked + gumbel, axis=-1)
     greedy = jnp.argmax(masked, axis=-1)
     return jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)

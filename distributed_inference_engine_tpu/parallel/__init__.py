@@ -1,0 +1,3 @@
+from .mesh import make_mesh, factor_devices, AXIS_NAMES  # noqa: F401
+from .sharding import ModelShardings, shard_params, param_pspecs  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,69 @@
+"""Device-mesh construction — the collective plane of the framework.
+
+The reference's "distributed communication backend" is hand-rolled asyncio TCP
+(SURVEY.md §2.4); its TPU-native successor is NOT a comms library: chip↔chip
+tensor traffic is emitted by XLA from sharding annotations over a
+``jax.sharding.Mesh``. This module owns mesh construction; ``sharding.py``
+owns the annotations; nothing in the framework ever opens a socket for
+tensors.
+
+Axis order is (dp, pp, sp, tp, ep) outermost→innermost so that
+tensor-parallel collectives — the per-layer, latency-critical ones — map to
+adjacent devices (ICI neighbors on a real slice), while dp/pp cross slower
+links at lower frequency. All five axes always exist (size 1 when unused):
+one mesh shape means one sharding-spec vocabulary everywhere, and a spec like
+``P(("dp",), None, ("tp",))`` works unchanged from 1 chip to a pod.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+AXIS_NAMES: Tuple[str, ...] = ("dp", "pp", "sp", "tp", "ep")
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the framework mesh.
+
+    With no config, all visible devices go on the tp axis (the single-host
+    default: one model, tensor-parallel across the slice — the
+    BASELINE.json configs[2] shape).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = MeshConfig(tp=len(devices))
+    sizes = [config.dp, config.pp, config.sp, config.tp, config.ep]
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(AXIS_NAMES, sizes))} wants {n} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.array(devices, dtype=object).reshape(sizes)
+    return Mesh(arr, AXIS_NAMES)
+
+
+def factor_devices(n: int, want_dp: bool = True) -> MeshConfig:
+    """Factor ``n`` devices into a sensible (dp, tp) split: tp gets the
+    largest power-of-two factor up to 8 (one v5e host's worth of ICI),
+    dp takes the rest."""
+    tp = 1
+    while tp * 2 <= min(n, 8) and n % (tp * 2) == 0:
+        tp *= 2
+    dp = n // tp if want_dp else 1
+    if not want_dp:
+        tp = n
+    return MeshConfig(dp=dp, tp=tp)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,141 @@
+"""Sharding specs: how the model lays out over the mesh.
+
+The TPU reinterpretation of the reference's hash-sharded placement
+(``src/model_registry.py:149-161``, SURVEY.md §2.3): there a "shard" is a
+worker holding a copy; here a shard is a slice of the tensor math itself,
+and the registry's ``ModelShard.mesh_axes``/``partition_spec`` fields record
+which recipe a placement uses.
+
+Megatron-style tensor parallelism, expressed as ``PartitionSpec`` trees that
+GSPMD propagates (per the scaling-book recipe: annotate params + a few
+activation constraints, let XLA insert the collectives):
+
+- attention: QKV projections column-sharded over ``tp`` (heads split),
+  output projection row-sharded (psum inserted by XLA after ``wo``);
+- MLP: up/gate column-sharded, down row-sharded (one psum per block);
+- embeddings/LM head: vocab-sharded over ``tp`` (logits all-gather at the
+  end — once per step, off the per-layer critical path);
+- KV cache: ``n_kv_heads`` over ``tp``, slots over ``dp`` — each chip holds
+  only its heads' cache, so HBM per chip drops linearly with tp;
+- norms: replicated (tiny).
+
+``ep`` is reserved for MoE expert sharding; ``pp`` for stage-split layers
+(the stacked ``[n_layers, ...]`` leading axis is exactly what pp will split).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.base import ModelSpec, Params
+
+REPLICATED = P()
+
+
+def param_pspecs(spec: ModelSpec) -> Dict[str, Any]:
+    """PartitionSpec tree matching ``init_params``' structure.
+
+    Leading block axis is the layer stack (pp's future split dim); attention
+    and MLP projections shard their feature dims over ``tp``.
+    """
+    blocks: Dict[str, P] = {
+        "ln1_scale": P(), "ln2_scale": P(),
+        # column-parallel: output features over tp
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        # row-parallel: input features over tp (XLA psums the partial sums)
+        "wo": P(None, "tp", None),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    if spec.mlp == "swiglu":
+        blocks["w_gate"] = P(None, None, "tp")
+    if spec.norm == "layernorm":
+        blocks["ln1_bias"] = P()
+        blocks["ln2_bias"] = P()
+    if spec.use_bias:
+        blocks.update({
+            "bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp"),
+            "bo": P(), "b_up": P(None, "tp"), "b_down": P(),
+        })
+    tree: Dict[str, Any] = {
+        "tok_emb": P("tp", None),          # vocab-sharded
+        "blocks": blocks,
+        "lnf_scale": P(),
+    }
+    if spec.norm == "layernorm":
+        tree["lnf_bias"] = P()
+    if spec.pos_emb == "learned":
+        tree["pos_emb"] = P()
+    if not spec.tie_embeddings:
+        tree["lm_head"] = P(None, "tp")    # vocab-sharded logits
+    return tree
+
+
+def kv_cache_pspec() -> P:
+    """[L, B, S, Hkv, Dh]: slots over dp, kv heads over tp."""
+    return P(None, "dp", None, "tp", None)
+
+
+def batch_pspec() -> P:
+    """[B, T] token batches: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+@dataclass
+class ModelShardings:
+    """Bundle of mesh + concrete NamedShardings for one model."""
+
+    mesh: Mesh
+    params: Any              # pytree of NamedSharding
+    kv: NamedSharding
+    batch: NamedSharding
+    replicated: NamedSharding
+
+    @classmethod
+    def build(cls, spec: ModelSpec, mesh: Mesh) -> "ModelShardings":
+        pspecs = param_pspecs(spec)
+        named = jax.tree.map(
+            lambda p: NamedSharding(mesh, p), pspecs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return cls(
+            mesh=mesh,
+            params=named,
+            kv=NamedSharding(mesh, kv_cache_pspec()),
+            batch=NamedSharding(mesh, batch_pspec()),
+            replicated=NamedSharding(mesh, REPLICATED),
+        )
+
+    def shard_fn(self):
+        """A ``params -> sharded params`` function for ``Engine(shard_fn=…)``."""
+        return lambda params: shard_params(params, self)
+
+
+def shard_params(params: Params, shardings: ModelShardings) -> Params:
+    """Place a param tree onto the mesh per the spec tree.
+
+    Divisibility guard: a tp-sharded dim that doesn't divide by the axis size
+    is a config error worth a clear message (XLA's would be cryptic).
+    """
+    def place(x, s: NamedSharding):
+        for dim, axes in enumerate(s.spec):
+            if axes is None:
+                continue
+            names = (axes,) if isinstance(axes, str) else axes
+            size = 1
+            for nm in names:
+                size *= s.mesh.shape[nm]
+            if x.shape[dim] % size:
+                raise ValueError(
+                    f"dim {dim} of shape {x.shape} not divisible by mesh "
+                    f"axes {names} (size {size})"
+                )
+        return jax.device_put(x, s)
+
+    return jax.tree.map(place, params, shardings.params)

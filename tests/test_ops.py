@@ -145,3 +145,17 @@ def test_temperature_spreads_choices():
     p = SamplingParams.make(1, temperature=1.0)
     seen = {sample_tokens(logits, p, jax.random.key(s)).tolist()[0] for s in range(20)}
     assert len(seen) > 1          # uniform logits at temp 1 should vary
+
+
+def test_top_p_nucleus_widens_with_temperature():
+    """Code-review regression: nucleus membership is judged on the TEMPERED
+    distribution (HF semantics) — high temperature must widen the nucleus."""
+    logits = jnp.asarray([[6.0, 2.0, 0.0, -10.0]])
+    # raw distribution: token 0 has ~0.98 mass => untempered nucleus@0.9 = {0}
+    cold = SamplingParams.make(1, temperature=0.05, top_p=0.9)
+    seen_cold = {int(sample_tokens(logits, cold, jax.random.key(s))[0]) for s in range(30)}
+    assert seen_cold == {0}
+    hot = SamplingParams.make(1, temperature=3.0, top_p=0.9)
+    seen_hot = {int(sample_tokens(logits, hot, jax.random.key(s))[0]) for s in range(30)}
+    assert len(seen_hot) > 1        # tempered softmax spreads mass; nucleus grows
+    assert 3 not in seen_hot        # the -10 tail stays excluded
