@@ -1,0 +1,2 @@
+from .coordinator import Coordinator, CoordinatorConfig  # noqa: F401
+from .frontend import CoordinatorServer, CoordinatorClient  # noqa: F401

@@ -1,0 +1,349 @@
+"""Coordinator: the front-end that composes cache → batcher → router/LB → worker.
+
+The reference *documents* this component — ``README.md:56-60`` ("coordinator
+consults kvstore for cache hits; on miss pushes to batcher") and the mermaid
+flow ``docs/router_vs_load_balancer.md:43-57`` (client → coordinator → router
+→ load balancer → worker) — but never implemented it; each layer only ran in
+its own demo (SURVEY.md §1 "missing-but-declared layer"). This class is that
+glue, delivered:
+
+1. **Cache.** Deterministic requests (temperature == 0) are answered from the
+   response cache when possible and populate it on the way out.
+2. **Batcher.** Misses are coalesced per ``model:version`` with the
+   size-OR-latency flush policy; the flushed batch is the XLA dispatch unit.
+3. **Placement.** If the registry holds shards for the model, each request's
+   affinity key picks its shard via consistent hashing (router, with
+   deterministic failover); otherwise the load balancer spreads batches over
+   equivalent replicas. This is exactly the router-vs-LB role split the
+   reference's docs prescribe.
+4. **Dispatch.** Framed RPC to the chosen worker's engine; transport failures
+   mark worker health and retry once on the alternate placement — with real
+   device state, failover means the prefix cache is cold on the new worker,
+   which is why failover is deterministic per key (SURVEY.md §7 hard-part #5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import BatcherConfig, CacheConfig, Config, HealthConfig, ModelConfig
+from ..cluster.load_balancer import (
+    LoadBalancer,
+    LoadBalancerStrategy,
+    NoHealthyWorkerError,
+)
+from ..cluster.registry import ModelRegistry, ModelStatus
+from ..cluster.router import Router, RoutingError
+from ..cluster.worker import (
+    WorkerClient,
+    WorkerRPCError,
+    request_from_dict,
+    result_to_dict,
+)
+from ..serving.batcher import PAD_INPUT, Batcher
+from ..serving.cache import ResponseCache
+from ..utils.tracing import RequestTrace, new_request_id
+
+logger = logging.getLogger(__name__)
+
+# transport-level trouble ⇒ health signal + retry; application errors
+# (WorkerRPCError) propagate to the caller untouched
+_TRANSPORT_ERRORS = (OSError, ConnectionError, asyncio.TimeoutError,
+                     asyncio.IncompleteReadError, EOFError)
+
+
+@dataclass
+class CoordinatorConfig:
+    batcher: BatcherConfig = field(default_factory=BatcherConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    health: HealthConfig = field(default_factory=HealthConfig)
+    lb_strategy: str = LoadBalancerStrategy.ROUND_ROBIN.value
+    dispatch_timeout_s: float = 120.0
+    cache_enabled: bool = True
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "CoordinatorConfig":
+        return cls(batcher=cfg.batcher, cache=cfg.cache, health=cfg.health)
+
+
+class Coordinator:
+    """The engine-of-engines: one object that owns the whole control plane."""
+
+    def __init__(self, config: Optional[CoordinatorConfig] = None) -> None:
+        self.config = config or CoordinatorConfig()
+        self.registry = ModelRegistry()
+        self.router = Router(self.registry, health=self.config.health)
+        self.lb = LoadBalancer(
+            strategy=LoadBalancerStrategy(self.config.lb_strategy),
+            health=self.config.health,
+        )
+        self.cache = ResponseCache(
+            max_size=self.config.cache.max_size,
+            policy=self.config.cache.policy,
+            default_ttl=self.config.cache.default_ttl,
+        )
+        self.batcher = Batcher(
+            batch_callback=self._run_batch,
+            max_batch_size=self.config.batcher.max_batch_size,
+            max_latency_ms=self.config.batcher.max_latency_ms,
+        )
+        self._running = False
+        self._cache_hits = 0
+        self._submitted = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        await self.batcher.start()
+        await self.router.start()
+        await self.lb.start()
+
+    async def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        await self.batcher.stop()
+        await self.router.stop()
+        await self.lb.stop()
+
+    # -- fleet membership ---------------------------------------------------
+
+    def add_worker(self, worker_id: str, host: str, port: int,
+                   **metadata: Any) -> None:
+        """Register a worker with both placement (router) and spreading (LB)."""
+        self.router.register_worker(worker_id, host, port, **metadata)
+        self.lb.register_worker(worker_id, host, port, **metadata)
+
+    def remove_worker(self, worker_id: str) -> bool:
+        a = self.router.unregister_worker(worker_id)
+        b = self.lb.unregister_worker(worker_id)
+        return a or b
+
+    async def deploy_model(
+        self,
+        cfg: ModelConfig,
+        worker_ids: Optional[Sequence[str]] = None,
+        load_timeout_s: float = 600.0,
+    ) -> int:
+        """Load ``cfg`` onto workers and register one shard per worker.
+
+        The registry's consistent hashing then spreads affinity keys across
+        those shards (reference deploy flow scattered across
+        ``examples/worker_demo.py`` + ``examples/router_demo.py``, unified).
+        Returns the number of shards deployed.
+        """
+        targets = list(worker_ids) if worker_ids else list(self.router.workers)
+        if not targets:
+            raise RoutingError("no workers to deploy to")
+        if self.registry.get_model_version(cfg.name, cfg.version) is None:
+            self.registry.register_model(cfg)
+        # idempotent scale-out: skip workers already hosting a shard, number
+        # new shards after the existing ones
+        existing = self.registry.all_shards(cfg.name, cfg.version)
+        hosted = {s.worker_id for s in existing}
+        next_id = max((s.shard_id for s in existing), default=-1) + 1
+        deployed = 0
+        for wid in targets:
+            if wid in hosted:
+                continue
+            client = self.router.client_for(wid)
+            await client.load_model(cfg, timeout=load_timeout_s)
+            self.registry.add_shard(cfg.name, cfg.version, shard_id=next_id,
+                                    worker_id=wid, status=ModelStatus.READY)
+            next_id += 1
+            deployed += 1
+        return deployed
+
+    # -- request path -------------------------------------------------------
+
+    async def submit(
+        self,
+        model: str,
+        prompt: Sequence[int],
+        version: str = "1.0",
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int = -1,
+        key: Optional[str] = None,
+        request_id: Optional[str] = None,
+        no_cache: bool = False,
+    ) -> Dict[str, Any]:
+        """One generation request, end to end. Returns a result dict
+        (``result_to_dict`` schema) plus trace/cache metadata."""
+        if not self._running:
+            raise RuntimeError("coordinator is not running")
+        self._submitted += 1
+        request_id = request_id or new_request_id()
+        affinity = key if key is not None else request_id
+        trace = RequestTrace(request_id=request_id)
+        trace.mark("received")
+
+        cacheable = (self.config.cache_enabled and not no_cache
+                     and temperature == 0.0)
+        cache_key: Optional[Tuple] = None
+        if cacheable:
+            cache_key = (model, version, tuple(prompt), max_new_tokens,
+                         top_k, top_p, eos_id)
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                self._cache_hits += 1
+                trace.mark("done")
+                # deep copy: callers may mutate result['tokens']/['metadata'],
+                # which must not corrupt the cached entry
+                out = copy.deepcopy(hit)
+                out["request_id"] = request_id
+                out["cached"] = True
+                out["trace"] = trace.to_dict()
+                return out
+
+        inputs = {
+            "prompt": list(prompt),
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "top_k": top_k,
+            "top_p": top_p,
+            "eos_id": eos_id,
+            "request_id": request_id,
+            "key": affinity,
+        }
+        future = await self.batcher.add_request(
+            model, version, inputs, request_id=request_id, trace=trace
+        )
+        result: Dict[str, Any] = await future
+        trace.mark("done")
+        result = dict(result)
+        result["cached"] = False
+        result["trace"] = trace.to_dict()
+        if cacheable and cache_key is not None:
+            stripped = {k: v for k, v in result.items()
+                        if k not in ("trace", "cached")}
+            self.cache.set(cache_key, stripped)
+        return result
+
+    # -- batch dispatch (the batcher's backend) -----------------------------
+
+    async def _run_batch(self, model: str, version: str,
+                         inputs: List[Any]) -> List[Dict[str, Any]]:
+        reals = [i for i in inputs if i is not PAD_INPUT
+                 and not (isinstance(i, dict) and i.get("__pad__"))]
+        if not reals:
+            return []
+        sharded = bool(self.registry.all_shards(model, version))
+        # group requests by target worker
+        groups: Dict[str, List[int]] = {}
+        if sharded:
+            for idx, inp in enumerate(reals):
+                route = self.router.route_request(model, version, inp["key"])
+                groups.setdefault(route.worker.worker_id, []).append(idx)
+        else:
+            picked = self.lb.get_worker()
+            groups[picked.worker_id] = list(range(len(reals)))
+
+        results: List[Any] = [None] * len(reals)
+
+        async def run_group(worker_id: str, idxs: List[int]) -> None:
+            reqs = [request_from_dict(reals[i]) for i in idxs]
+            try:
+                outs = await self._dispatch_with_retry(
+                    model, version, worker_id, reqs,
+                    keys=[reals[i]["key"] for i in idxs], sharded=sharded,
+                )
+            except Exception as e:
+                # isolate the failure to this group's requests — other
+                # groups' completed generations must not be discarded (the
+                # batcher fans an Exception entry to just that future)
+                for i in idxs:
+                    results[i] = e
+                return
+            for i, out in zip(idxs, outs):
+                results[i] = out
+
+        await asyncio.gather(*(run_group(w, idxs)
+                               for w, idxs in groups.items()))
+        return results  # aligned with the real inputs, pads dropped
+
+    async def _dispatch_with_retry(
+        self, model: str, version: str, worker_id: str,
+        reqs: List, keys: List[str], sharded: bool,
+    ) -> List[Dict[str, Any]]:
+        try:
+            return await self._dispatch_once(model, worker_id, reqs)
+        except _TRANSPORT_ERRORS as e:
+            # _dispatch_once already marked the failure — don't double-count
+            logger.warning("dispatch to %s failed (%s: %s) — retrying on "
+                           "alternate", worker_id, type(e).__name__, e)
+            alt = self._pick_alternate(model, version, worker_id,
+                                       keys[0], sharded)
+            if alt is None:
+                raise
+            return await self._dispatch_once(model, alt, reqs)
+
+    def _pick_alternate(self, model: str, version: str, failed: str,
+                        key: str, sharded: bool) -> Optional[str]:
+        if sharded:
+            if not self.config.health.enable_failover:
+                return None
+            failed_shards = [s.shard_id for s
+                             in self.registry.all_shards(model, version)
+                             if s.worker_id == failed]
+            alt = self.router._find_alternative_shard(
+                model, version, key,
+                exclude=failed_shards[0] if failed_shards else -1,
+            )
+            return alt.worker_id if alt and alt.worker_id != failed else None
+        candidates = [s for s in self.lb.healthy_workers()
+                      if s.worker_id != failed]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda s: s.active_connections).worker_id
+
+    async def _dispatch_once(self, model: str, worker_id: str,
+                             reqs: List) -> List[Dict[str, Any]]:
+        client = (self.router.client_for(worker_id)
+                  if worker_id in self.router.workers
+                  else self.lb.client_for(worker_id))
+        self.lb.acquire(worker_id)
+        t0 = time.perf_counter()
+        try:
+            results = await client.generate(
+                model, reqs, timeout=self.config.dispatch_timeout_s
+            )
+        except Exception as e:
+            # every failed request counts against the worker's LB stats
+            # (reference update_stats semantics); only transport-level
+            # trouble additionally dents router health — an app error
+            # (e.g. bad model name) doesn't mean the worker is down
+            self.lb.update_stats(worker_id, success=False,
+                                 latency_s=time.perf_counter() - t0)
+            if not isinstance(e, WorkerRPCError):
+                self.router.mark_worker_failure(worker_id)
+            raise
+        finally:
+            self.lb.release(worker_id)
+        self.lb.update_stats(worker_id, success=True,
+                             latency_s=time.perf_counter() - t0)
+        self.router.mark_worker_success(worker_id)
+        return [result_to_dict(r) for r in results]
+
+    # -- introspection ------------------------------------------------------
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "submitted": self._submitted,
+            "cache_hits": self._cache_hits,
+            "cache": self.cache.get_stats(),
+            "batcher": self.batcher.get_stats(),
+            "router": self.router.get_stats(),
+            "load_balancer": self.lb.get_all_stats(),
+            "registry": self.registry.get_stats(),
+        }

@@ -1,0 +1,187 @@
+"""Coordinator front-end: framed-RPC server + user-facing client.
+
+The network face of the coordinator — what the reference's README calls "the
+central API server" (``README.md:56-60``) and its ``examples/example_client.py``
+(declared at ``README.md:40``, never written) would have talked to. Speaks the
+same length-prefixed frame protocol as the workers (``utils/framing.py``), so
+one wire format covers client→coordinator and coordinator→worker hops.
+
+Methods: ``generate`` (token-space; batching/caching/routing applied),
+``deploy_model``, ``add_worker`` / ``remove_worker``, ``stats``, ``models``,
+``ping``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..config import ModelConfig, ServerConfig
+from ..utils.framing import FrameError, read_frame, write_frame
+from ..utils.rpc import FramedRPCClient
+from .coordinator import Coordinator
+
+logger = logging.getLogger(__name__)
+
+
+class CoordinatorServer:
+    """Serves a ``Coordinator`` over framed RPC."""
+
+    def __init__(self, coordinator: Coordinator,
+                 config: Optional[ServerConfig] = None) -> None:
+        self.coordinator = coordinator
+        self.config = config or ServerConfig(worker_id="coordinator")
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_writers: set = set()
+        self._methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]] = {
+            "ping": self._rpc_ping,
+            "generate": self._rpc_generate,
+            "deploy_model": self._rpc_deploy_model,
+            "add_worker": self._rpc_add_worker,
+            "remove_worker": self._rpc_remove_worker,
+            "stats": self._rpc_stats,
+            "models": self._rpc_models,
+        }
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        if self._server is None:
+            raise RuntimeError("server not started")
+        host, port = self._server.sockets[0].getsockname()[:2]
+        return host, port
+
+    async def start(self) -> Tuple[str, int]:
+        await self.coordinator.start()
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.config.host, self.config.port
+        )
+        host, port = self.address
+        logger.info("coordinator listening on %s:%d", host, port)
+        return host, port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conn_writers):  # see WorkerServer.stop
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+        await self.coordinator.stop()
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conn_writers.add(writer)
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader,
+                                           max_frame=self.config.max_frame_bytes)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                except FrameError as e:
+                    await write_frame(writer, {"success": False,
+                                               "error": f"bad frame: {e}"})
+                    break
+                # handle each request concurrently so one slow generate
+                # doesn't head-of-line-block other requests on the connection?
+                # no — responses must come back in frame order on one stream;
+                # concurrent clients should use concurrent connections.
+                response = await self._dispatch(msg)
+                await write_frame(writer, response)
+        finally:
+            self._conn_writers.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _dispatch(self, msg: Any) -> Dict[str, Any]:
+        if not isinstance(msg, dict) or "method" not in msg:
+            return {"success": False, "error": "message must be a dict with 'method'"}
+        handler = self._methods.get(msg["method"])
+        req_id = msg.get("id", "")
+        if handler is None:
+            return {"id": req_id, "success": False,
+                    "error": f"unknown method {msg['method']!r}"}
+        try:
+            result = await handler(msg)
+            return {"id": req_id, "success": True, "result": result}
+        except Exception as e:
+            logger.warning("coordinator: %s failed: %s", msg["method"], e)
+            return {"id": req_id, "success": False, "error": str(e)}
+
+    # -- methods ------------------------------------------------------------
+
+    async def _rpc_ping(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return {"time": time.time(), "role": "coordinator"}
+
+    async def _rpc_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return await self.coordinator.submit(
+            model=msg["model"],
+            prompt=msg["prompt"],
+            version=msg.get("version", "1.0"),
+            max_new_tokens=int(msg.get("max_new_tokens", 16)),
+            temperature=float(msg.get("temperature", 0.0)),
+            top_k=int(msg.get("top_k", 0)),
+            top_p=float(msg.get("top_p", 1.0)),
+            eos_id=int(msg.get("eos_id", -1)),
+            key=msg.get("key"),
+            request_id=msg.get("request_id"),
+            no_cache=bool(msg.get("no_cache", False)),
+        )
+
+    async def _rpc_deploy_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = ModelConfig.from_dict(msg["config"])
+        n = await self.coordinator.deploy_model(
+            cfg, worker_ids=msg.get("workers") or None
+        )
+        return {"model": cfg.name, "shards": n}
+
+    async def _rpc_add_worker(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        self.coordinator.add_worker(msg["worker_id"], msg["host"],
+                                    int(msg["port"]))
+        return {"added": msg["worker_id"]}
+
+    async def _rpc_remove_worker(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return {"removed": self.coordinator.remove_worker(msg["worker_id"])}
+
+    async def _rpc_stats(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return self.coordinator.get_stats()
+
+    async def _rpc_models(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        reg = self.coordinator.registry
+        return {"models": {name: reg.list_versions(name)
+                           for name in reg.list_models()}}
+
+
+class CoordinatorClient(FramedRPCClient):
+    """User-facing client (the README's promised ``example_client``,
+    ``README.md:40``) — persistent connection, one call per frame pair
+    (shared plumbing in ``utils/rpc.py``)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0) -> None:
+        super().__init__(host, port, timeout=timeout)
+
+    async def generate(self, model: str, prompt: List[int],
+                       **kwargs: Any) -> Dict[str, Any]:
+        return await self.call("generate", model=model, prompt=list(prompt),
+                               **kwargs)
+
+    async def deploy_model(self, cfg: ModelConfig,
+                           workers: Optional[List[str]] = None,
+                           timeout: float = 600.0) -> Dict[str, Any]:
+        return await self.call("deploy_model", config=cfg.to_dict(),
+                               workers=workers, timeout=timeout)
+
+    async def add_worker(self, worker_id: str, host: str, port: int) -> None:
+        await self.call("add_worker", worker_id=worker_id, host=host, port=port)
+
+    async def stats(self) -> Dict[str, Any]:
+        return await self.call("stats")
+
+    async def ping(self) -> Dict[str, Any]:
+        return await self.call("ping", timeout=5.0)

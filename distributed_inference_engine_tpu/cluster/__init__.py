@@ -4,3 +4,22 @@ from .registry import (  # noqa: F401
     ModelVersion,
     ModelRegistry,
 )
+from .worker import (  # noqa: F401
+    WorkerServer,
+    WorkerClient,
+    WorkerRPCError,
+    build_engine,
+)
+from .router import (  # noqa: F401
+    Router,
+    RouteResult,
+    RoutingError,
+    WorkerHealth,
+    WorkerInfo,
+)
+from .load_balancer import (  # noqa: F401
+    LoadBalancer,
+    LoadBalancerStrategy,
+    NoHealthyWorkerError,
+    WorkerStats,
+)

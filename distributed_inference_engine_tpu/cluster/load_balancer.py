@@ -1,0 +1,279 @@
+"""Load balancer: strategy-based worker selection over the healthy set.
+
+Capability heir of the reference's ``src/load_balancer.py``: four selection
+strategies — round-robin (``:231-244``), least-connections (``:246-261``),
+random (``:263-274``), least-latency (``:276-291``) — applied over workers
+whose consecutive-failure count is under the threshold (``:150-153``), with
+runtime register/unregister (``:97-126``), per-worker request/latency/error
+stats (``:166-226``), and a periodic health loop (``:293-348``).
+
+Reference pitfall fixed (SURVEY.md §5 failure-detection row): the reference's
+health probes write their own timings into the same ``request_count``/
+``total_latency`` fields the LEAST_LATENCY strategy reads
+(``src/load_balancer.py:334-339``), so an idle worker's latency profile is
+probe noise. Here probe outcomes only touch health fields; request stats come
+only from ``update_stats`` calls on real traffic. Probes are also a real
+``ping`` RPC rather than a bare TCP connect.
+
+Role split vs the router (reference ``docs/router_vs_load_balancer.md``): the
+router answers "which shard *must* serve this key" (placement/affinity); the
+LB answers "which of the equivalent replicas *should* take the next request"
+(spreading). In TPU terms: the router picks the mesh partition, the LB picks
+among data-parallel replicas of it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import itertools
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import HealthConfig
+from .worker import WorkerClient
+
+logger = logging.getLogger(__name__)
+
+
+class LoadBalancerStrategy(str, enum.Enum):
+    """Reference ``src/load_balancer.py:18-23``."""
+
+    ROUND_ROBIN = "round_robin"
+    LEAST_CONNECTIONS = "least_connections"
+    RANDOM = "random"
+    LEAST_LATENCY = "least_latency"
+
+
+@dataclass
+class WorkerStats:
+    """Reference ``src/load_balancer.py:25-37`` — with probe stats separated."""
+
+    worker_id: str
+    host: str
+    port: int
+    active_connections: int = 0
+    request_count: int = 0
+    error_count: int = 0
+    total_latency_s: float = 0.0
+    consecutive_failures: int = 0
+    last_probe: float = 0.0
+    probe_count: int = 0
+    probe_failures: int = 0
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def avg_latency_s(self) -> float:
+        """Reference ``src/load_balancer.py:34-37`` — real traffic only."""
+        return self.total_latency_s / self.request_count if self.request_count else 0.0
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class NoHealthyWorkerError(RuntimeError):
+    pass
+
+
+class LoadBalancer:
+    """Reference ``src/load_balancer.py:39-348``."""
+
+    def __init__(
+        self,
+        strategy: LoadBalancerStrategy = LoadBalancerStrategy.ROUND_ROBIN,
+        health: Optional[HealthConfig] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.strategy = LoadBalancerStrategy(strategy)
+        self.health_config = health or HealthConfig()
+        self.workers: Dict[str, WorkerStats] = {}
+        self._rr = itertools.count()
+        self._rand = random.Random(seed)
+        self._clients: Dict[str, WorkerClient] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._running = False
+        self._pick_count = 0
+        self._strategies = {
+            LoadBalancerStrategy.ROUND_ROBIN: self._round_robin,
+            LoadBalancerStrategy.LEAST_CONNECTIONS: self._least_connections,
+            LoadBalancerStrategy.RANDOM: self._random,
+            LoadBalancerStrategy.LEAST_LATENCY: self._least_latency,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._health_task = asyncio.create_task(self._health_loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()
+
+    # -- membership (reference src/load_balancer.py:97-126) -------------------
+
+    def register_worker(self, worker_id: str, host: str, port: int,
+                        **metadata: Any) -> WorkerStats:
+        stats = WorkerStats(worker_id=worker_id, host=host, port=port,
+                            metadata=metadata)
+        self.workers[worker_id] = stats
+        logger.info("lb: registered worker %s at %s", worker_id, stats.address)
+        return stats
+
+    def unregister_worker(self, worker_id: str) -> bool:
+        stats = self.workers.pop(worker_id, None)
+        client = self._clients.pop(worker_id, None)
+        if client is not None:
+            try:
+                asyncio.get_running_loop().create_task(client.close())
+            except RuntimeError:
+                pass
+        return stats is not None
+
+    def client_for(self, worker_id: str) -> WorkerClient:
+        stats = self.workers.get(worker_id)
+        if stats is None:
+            raise NoHealthyWorkerError(f"unknown worker {worker_id!r}")
+        client = self._clients.get(worker_id)
+        if client is None:
+            client = WorkerClient(stats.host, stats.port)
+            self._clients[worker_id] = client
+        return client
+
+    # -- selection (reference src/load_balancer.py:128-164) -------------------
+
+    def _is_healthy(self, s: WorkerStats) -> bool:
+        return s.consecutive_failures < self.health_config.max_consecutive_failures
+
+    def healthy_workers(self) -> List[WorkerStats]:
+        return [s for s in self.workers.values() if self._is_healthy(s)]
+
+    def get_worker(self, pinned: Optional[str] = None) -> WorkerStats:
+        """Pick a worker; ``pinned`` forces a specific healthy worker
+        (reference pinned-worker path, ``src/load_balancer.py:144-147``)."""
+        self._pick_count += 1
+        if pinned is not None:
+            s = self.workers.get(pinned)
+            if s is None or not self._is_healthy(s):
+                raise NoHealthyWorkerError(f"pinned worker {pinned!r} unavailable")
+            return s
+        healthy = self.healthy_workers()
+        if not healthy:
+            raise NoHealthyWorkerError("no healthy workers registered")
+        healthy.sort(key=lambda s: s.worker_id)   # deterministic strategy input
+        return self._strategies[self.strategy](healthy)
+
+    def _round_robin(self, healthy: List[WorkerStats]) -> WorkerStats:
+        return healthy[next(self._rr) % len(healthy)]
+
+    def _least_connections(self, healthy: List[WorkerStats]) -> WorkerStats:
+        return min(healthy, key=lambda s: s.active_connections)
+
+    def _random(self, healthy: List[WorkerStats]) -> WorkerStats:
+        return self._rand.choice(healthy)
+
+    def _least_latency(self, healthy: List[WorkerStats]) -> WorkerStats:
+        # cold workers (no real traffic yet) sort first so they get sampled
+        return min(healthy, key=lambda s: s.avg_latency_s)
+
+    # -- traffic accounting (reference src/load_balancer.py:166-191) ----------
+
+    def acquire(self, worker_id: str) -> None:
+        s = self.workers.get(worker_id)
+        if s is not None:
+            s.active_connections += 1
+
+    def release(self, worker_id: str) -> None:
+        s = self.workers.get(worker_id)
+        if s is not None and s.active_connections > 0:
+            s.active_connections -= 1
+
+    def update_stats(self, worker_id: str, success: bool,
+                     latency_s: float) -> None:
+        s = self.workers.get(worker_id)
+        if s is None:
+            return
+        s.request_count += 1
+        s.total_latency_s += latency_s
+        if success:
+            s.consecutive_failures = 0     # reference :187-191
+        else:
+            s.error_count += 1
+            s.consecutive_failures += 1
+
+    # -- health loop (reference src/load_balancer.py:293-348) -----------------
+
+    async def _health_loop(self) -> None:
+        while self._running:
+            try:
+                await self.check_all_workers()
+            except Exception:
+                logger.exception("lb: health sweep failed")
+            await asyncio.sleep(self.health_config.check_interval)
+
+    async def check_all_workers(self) -> None:
+        if self.workers:
+            await asyncio.gather(*(self.check_worker(w)
+                                   for w in list(self.workers)))
+
+    async def check_worker(self, worker_id: str) -> bool:
+        """Ping probe. Touches only health/probe fields — never the request
+        stats the LEAST_LATENCY strategy reads (fixed reference pitfall)."""
+        s = self.workers.get(worker_id)
+        if s is None:
+            return False
+        s.last_probe = time.monotonic()
+        s.probe_count += 1
+        try:
+            await self.client_for(worker_id).ping(
+                timeout=self.health_config.check_timeout
+            )
+        except Exception as e:
+            logger.debug("lb: probe of %s failed: %s", worker_id, e)
+            s.probe_failures += 1
+            s.consecutive_failures += 1
+            return False
+        s.consecutive_failures = 0
+        return True
+
+    # -- introspection (reference src/load_balancer.py:193-226) ---------------
+
+    def get_worker_stats(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        s = self.workers.get(worker_id)
+        if s is None:
+            return None
+        return {
+            "worker_id": s.worker_id,
+            "address": s.address,
+            "healthy": self._is_healthy(s),
+            "active_connections": s.active_connections,
+            "request_count": s.request_count,
+            "error_count": s.error_count,
+            "avg_latency_s": s.avg_latency_s,
+            "consecutive_failures": s.consecutive_failures,
+            "probe_count": s.probe_count,
+            "probe_failures": s.probe_failures,
+        }
+
+    def get_all_stats(self) -> Dict[str, Any]:
+        return {
+            "strategy": self.strategy.value,
+            "pick_count": self._pick_count,
+            "workers": {wid: self.get_worker_stats(wid) for wid in self.workers},
+            "healthy_count": len(self.healthy_workers()),
+        }

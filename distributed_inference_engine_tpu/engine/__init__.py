@@ -1,2 +1,15 @@
-from .kv_cache import SlotKVCache  # noqa: F401
-from .engine import Engine, GenerationRequest, GenerationResult  # noqa: F401
+from .types import GenerationRequest, GenerationResult  # noqa: F401
+
+
+def __getattr__(name):
+    # Engine/SlotKVCache import jax; load them lazily so jax-free control
+    # planes can import this package for the request/result types alone.
+    if name == "Engine":
+        from .engine import Engine
+
+        return Engine
+    if name == "SlotKVCache":
+        from .kv_cache import SlotKVCache
+
+        return SlotKVCache
+    raise AttributeError(name)

@@ -24,7 +24,6 @@ serving):
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -43,30 +42,7 @@ from ..models.base import (
 )
 from ..ops.sampling import SamplingParams, sample_tokens
 from ..utils.tracing import LatencyStats
-
-
-@dataclass
-class GenerationRequest:
-    """One generation job (token-id space; tokenization is a host concern)."""
-
-    prompt: List[int]
-    max_new_tokens: int = 16
-    temperature: float = 0.0
-    top_k: int = 0
-    top_p: float = 1.0
-    request_id: str = ""
-    eos_id: int = -1                  # -1: never stops early
-
-
-@dataclass
-class GenerationResult:
-    request_id: str
-    tokens: List[int]                 # generated token ids (no prompt)
-    finish_reason: str                # "stop" | "length"
-    prompt_tokens: int = 0
-    ttft_s: float = 0.0               # prefill + first sample wall time
-    decode_s: float = 0.0
-    metadata: Dict[str, Any] = field(default_factory=dict)
+from .types import GenerationRequest, GenerationResult  # noqa: F401  (re-export)
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:

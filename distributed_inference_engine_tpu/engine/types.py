@@ -1,0 +1,35 @@
+"""jax-free generation request/result types.
+
+Split out of ``engine.engine`` so control-plane hosts (coordinator, registry,
+router — no TPU, no jax import cost) can marshal requests without pulling in
+the device stack. ``engine.engine`` re-exports both names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class GenerationRequest:
+    """One generation job (token-id space; tokenization is a host concern)."""
+
+    prompt: List[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    request_id: str = ""
+    eos_id: int = -1                  # -1: never stops early
+
+
+@dataclass
+class GenerationResult:
+    request_id: str
+    tokens: List[int]                 # generated token ids (no prompt)
+    finish_reason: str                # "stop" | "length"
+    prompt_tokens: int = 0
+    ttft_s: float = 0.0               # prefill + first sample wall time
+    decode_s: float = 0.0
+    metadata: Dict[str, Any] = field(default_factory=dict)

@@ -33,3 +33,49 @@ def build_engine(architecture: str, **kwargs):
         raise ValueError(f"unknown architecture {architecture!r}")
     real_keys = ("params", "config", "seed", "shard_fn")
     return Engine(spec, **{k: v for k, v in kwargs.items() if k in real_keys})
+
+
+def spec_for_architecture(architecture: str, size: str = "",
+                          max_seq_len: int = 0):
+    """One spec-selection rule for every call site (keyword factory above,
+    config-driven factory below) so matching can't drift."""
+    overrides = {"max_seq_len": max_seq_len} if max_seq_len else {}
+    if architecture.startswith("gpt2"):
+        return gpt2_spec(size or architecture, **overrides)
+    if architecture.startswith("llama"):
+        name = size or (architecture if "-" in architecture else "llama3-8b")
+        return llama_spec(name, **overrides)
+    raise ValueError(f"unknown architecture {architecture!r}")
+
+
+def engine_from_config(cfg):
+    """``ModelConfig`` → engine: the worker-side factory (replaces the
+    reference's hard-wired ``FakeModel(config)``, ``src/worker.py:171``).
+    Loads HF safetensors when ``cfg.path`` is a checkpoint dir, else random
+    init — enough for perf work and smoke tests."""
+    import os
+
+    arch = cfg.architecture.lower()
+    if arch == "fake":
+        return FakeEngine(
+            latency_s=float(cfg.metadata.get("latency_s", 0.0)),
+            per_token_latency_s=float(cfg.metadata.get("per_token_latency_s", 0.0)),
+            error_rate=float(cfg.metadata.get("error_rate", 0.0)),
+        )
+
+    from ..config import EngineConfig
+    from ..engine.engine import Engine
+    from .loader import load_checkpoint, spec_from_hf_config
+
+    spec = spec_for_architecture(arch, size=cfg.metadata.get("size", ""),
+                                 max_seq_len=cfg.max_seq_len)
+    if cfg.path and os.path.isdir(cfg.path):
+        hf_spec = spec_from_hf_config(cfg.path)
+        spec = hf_spec.replace(max_seq_len=min(cfg.max_seq_len,
+                                               hf_spec.max_seq_len))
+        params = load_checkpoint(cfg.path, spec)
+    else:
+        params = None
+    ecfg = EngineConfig(max_slots=cfg.max_batch_size,
+                        max_seq_len=cfg.max_seq_len)
+    return Engine(spec, params=params, config=ecfg)

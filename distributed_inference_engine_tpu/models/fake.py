@@ -19,7 +19,7 @@ import random
 import time
 from typing import Any, Dict, List, Optional
 
-from ..engine.engine import GenerationRequest, GenerationResult
+from ..engine.types import GenerationRequest, GenerationResult
 from ..utils.tracing import LatencyStats
 
 

@@ -230,7 +230,13 @@ class Batcher:
                     f"results for {n_real} requests"
                 )
             for req, result in zip(reqs, results):
-                if not req.future.done():
+                if req.future.done():
+                    continue
+                if isinstance(result, BaseException):
+                    # backend may fail a subset (e.g. one worker group of a
+                    # split batch) without discarding the others' results
+                    req.future.set_exception(result)
+                else:
                     req.future.set_result(result)
         except Exception as exc:  # fan the error out to every waiter
             self._total_errors += 1

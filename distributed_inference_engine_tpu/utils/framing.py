@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 try:
     import msgpack
@@ -80,22 +80,31 @@ def _decode_payload(codec: int, payload: bytes) -> Any:
 
 
 async def read_frame(
-    reader: asyncio.StreamReader, max_frame: int = DEFAULT_MAX_FRAME
+    reader: asyncio.StreamReader,
+    max_frame: int = DEFAULT_MAX_FRAME,
+    timeout: Optional[float] = None,
 ) -> Any:
     """Read exactly one framed message from the stream.
 
     Raises asyncio.IncompleteReadError on clean EOF mid-frame, FrameError on
-    corruption. Unlike the reference's single read() call, this always
-    receives complete messages regardless of TCP segmentation.
+    corruption, asyncio.TimeoutError if the full frame doesn't arrive within
+    ``timeout`` seconds. Unlike the reference's single read() call, this
+    always receives complete messages regardless of TCP segmentation.
     """
-    header = await reader.readexactly(HEADER_SIZE)
-    magic, codec, _flags, length = HEADER.unpack(header)
-    if magic != MAGIC:
-        raise FrameError(f"bad magic 0x{magic:04x}")
-    if length > max_frame:
-        raise FrameError(f"frame of {length} bytes exceeds max {max_frame}")
-    payload = await reader.readexactly(length)
-    return _decode_payload(codec, payload)
+
+    async def _read() -> Any:
+        header = await reader.readexactly(HEADER_SIZE)
+        magic, codec, _flags, length = HEADER.unpack(header)
+        if magic != MAGIC:
+            raise FrameError(f"bad magic 0x{magic:04x}")
+        if length > max_frame:
+            raise FrameError(f"frame of {length} bytes exceeds max {max_frame}")
+        payload = await reader.readexactly(length)
+        return _decode_payload(codec, payload)
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout=timeout)
 
 
 async def write_frame(

@@ -1,0 +1,84 @@
+"""Shared framed-RPC client plumbing.
+
+One implementation of connect/reconnect/locking/call for every framed-RPC
+peer (worker client, coordinator client) — the reference had no client class
+at all, and two hand-rolled copies would drift (they briefly did: one copy
+lost the malformed-response guard).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from .framing import read_frame, write_frame
+
+
+class RPCError(RuntimeError):
+    """Peer-reported request failure (distinct from transport failure)."""
+
+
+class FramedRPCClient:
+    """Persistent framed-RPC connection: one in-flight call at a time,
+    transparent reconnect after a drop, poisoned-connection teardown."""
+
+    def __init__(self, host: str, port: int,
+                 timeout: float = 30.0,
+                 max_frame: int = 64 * 1024 * 1024) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.max_frame = max_frame
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self._seq = 0
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def _ensure_connected(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port
+            )
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            self._reader = self._writer = None
+
+    async def call(self, method: str, *, timeout: Optional[float] = None,
+                   **params: Any) -> Any:
+        """Send one request frame, await one response frame.
+
+        Raises ``RPCError`` when the peer reports failure; transport trouble
+        (``OSError``/``asyncio.TimeoutError``/...) propagates for callers —
+        router/LB — to turn into health signals.
+        """
+        self._seq += 1
+        msg = {"method": method, "id": f"{id(self):x}-{self._seq}", **params}
+        effective = timeout if timeout is not None else self.timeout
+        async with self._lock:  # one in-flight call per connection
+            # the timeout must bound the connect too — a blackholed host
+            # otherwise hangs the OS TCP connect (~2 min) with the lock held
+            await asyncio.wait_for(self._ensure_connected(), timeout=effective)
+            assert self._reader is not None and self._writer is not None
+            try:
+                await write_frame(self._writer, msg)
+                response = await read_frame(
+                    self._reader, max_frame=self.max_frame, timeout=effective,
+                )
+            except Exception:
+                await self.close()  # poisoned connection — drop it
+                raise
+        if not isinstance(response, dict):
+            raise RPCError(f"malformed response: {response!r}")
+        if not response.get("success"):
+            raise RPCError(response.get("error", "unknown peer error"))
+        return response.get("result")

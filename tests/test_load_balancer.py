@@ -1,0 +1,140 @@
+"""Load balancer tests — all four strategies, healthy-set filtering, pinned
+workers, probe/request stat separation (reference pitfall,
+``src/load_balancer.py:334-339``), live health probes."""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.config import HealthConfig, ServerConfig
+from distributed_inference_engine_tpu.cluster.load_balancer import (
+    LoadBalancer,
+    LoadBalancerStrategy,
+    NoHealthyWorkerError,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+
+
+def make_lb(strategy=LoadBalancerStrategy.ROUND_ROBIN, n=3, **health_kw):
+    lb = LoadBalancer(strategy=strategy, health=HealthConfig(**health_kw),
+                      seed=0)
+    for i in range(n):
+        lb.register_worker(f"w{i}", "127.0.0.1", 20000 + i)
+    return lb
+
+
+def test_round_robin_cycles_evenly():
+    lb = make_lb()
+    picks = [lb.get_worker().worker_id for _ in range(9)]
+    assert picks == ["w0", "w1", "w2"] * 3
+
+
+def test_least_connections_prefers_idle():
+    lb = make_lb(LoadBalancerStrategy.LEAST_CONNECTIONS)
+    lb.acquire("w0")
+    lb.acquire("w0")
+    lb.acquire("w1")
+    assert lb.get_worker().worker_id == "w2"
+    lb.release("w0")
+    lb.release("w0")
+    assert lb.get_worker().worker_id in ("w0", "w2")
+
+
+def test_random_is_seeded_and_healthy_only():
+    lb = make_lb(LoadBalancerStrategy.RANDOM)
+    picks = {lb.get_worker().worker_id for _ in range(50)}
+    assert picks == {"w0", "w1", "w2"}
+
+
+def test_least_latency_tracks_real_traffic():
+    lb = make_lb(LoadBalancerStrategy.LEAST_LATENCY)
+    lb.update_stats("w0", success=True, latency_s=0.5)
+    lb.update_stats("w1", success=True, latency_s=0.1)
+    lb.update_stats("w2", success=True, latency_s=0.9)
+    assert lb.get_worker().worker_id == "w1"
+
+
+def test_unhealthy_workers_filtered_and_recover():
+    lb = make_lb(max_consecutive_failures=2)
+    lb.update_stats("w0", success=False, latency_s=0.1)
+    lb.update_stats("w0", success=False, latency_s=0.1)
+    picks = {lb.get_worker().worker_id for _ in range(10)}
+    assert "w0" not in picks
+    lb.update_stats("w0", success=True, latency_s=0.1)   # recovery resets
+    picks = {lb.get_worker().worker_id for _ in range(10)}
+    assert "w0" in picks
+
+
+def test_no_healthy_workers_raises():
+    lb = make_lb(n=1, max_consecutive_failures=1)
+    lb.update_stats("w0", success=False, latency_s=0.1)
+    with pytest.raises(NoHealthyWorkerError):
+        lb.get_worker()
+
+
+def test_pinned_worker_path():
+    lb = make_lb(max_consecutive_failures=1)
+    assert lb.get_worker(pinned="w1").worker_id == "w1"
+    lb.update_stats("w1", success=False, latency_s=0.1)
+    with pytest.raises(NoHealthyWorkerError, match="pinned"):
+        lb.get_worker(pinned="w1")
+    with pytest.raises(NoHealthyWorkerError, match="pinned"):
+        lb.get_worker(pinned="ghost")
+
+
+def test_unregister_shrinks_rotation():
+    lb = make_lb()
+    assert lb.unregister_worker("w1") is True
+    assert lb.unregister_worker("w1") is False
+    picks = {lb.get_worker().worker_id for _ in range(6)}
+    assert picks == {"w0", "w2"}
+
+
+async def test_probes_never_touch_request_stats():
+    """The reference's probes polluted avg-latency used by LEAST_LATENCY —
+    here probe outcomes live in probe_* fields only."""
+    lb = LoadBalancer(strategy=LoadBalancerStrategy.LEAST_LATENCY,
+                      health=HealthConfig(check_timeout=1.0))
+    server = WorkerServer(ServerConfig(worker_id="wl", port=0))
+    host, port = await server.start()
+    lb.register_worker("wl", host, port)
+    try:
+        for _ in range(5):
+            assert await lb.check_worker("wl") is True
+        s = lb.workers["wl"]
+        assert s.probe_count == 5
+        assert s.request_count == 0
+        assert s.avg_latency_s == 0.0
+    finally:
+        await lb.stop()
+        await server.stop()
+
+
+async def test_probe_failures_mark_unhealthy_then_recover():
+    lb = make_lb(n=0, max_consecutive_failures=2, check_timeout=0.5)
+    lb.register_worker("w", "127.0.0.1", 1)      # dead port
+    assert await lb.check_worker("w") is False
+    assert await lb.check_worker("w") is False
+    assert lb.healthy_workers() == []
+    server = WorkerServer(ServerConfig(worker_id="w", port=0))
+    host, port = await server.start()
+    lb.workers["w"].host, lb.workers["w"].port = host, port
+    lb._clients.pop("w", None)                   # drop stale client
+    try:
+        assert await lb.check_worker("w") is True
+        assert [s.worker_id for s in lb.healthy_workers()] == ["w"]
+    finally:
+        await lb.stop()
+        await server.stop()
+
+
+def test_stats_schema():
+    lb = make_lb()
+    lb.update_stats("w0", success=True, latency_s=0.2)
+    all_stats = lb.get_all_stats()
+    assert all_stats["strategy"] == "round_robin"
+    assert all_stats["healthy_count"] == 3
+    w0 = all_stats["workers"]["w0"]
+    assert w0["request_count"] == 1
+    assert w0["avg_latency_s"] == pytest.approx(0.2)
+    assert lb.get_worker_stats("ghost") is None

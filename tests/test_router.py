@@ -1,0 +1,140 @@
+"""Router tests — affinity routing determinism, health threshold state
+machine, deterministic failover, ping-RPC probes against live workers, and
+re-admission on recovery (reference ``src/router.py`` semantics,
+tests/test_registry.py:77-117 determinism discipline)."""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.config import HealthConfig, ModelConfig, ServerConfig
+from distributed_inference_engine_tpu.cluster.registry import ModelRegistry, ModelStatus
+from distributed_inference_engine_tpu.cluster.router import (
+    Router,
+    RoutingError,
+    WorkerHealth,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+
+
+def make_router(n_workers=3, n_shards=3, **health_kw):
+    registry = ModelRegistry()
+    cfg = ModelConfig(name="m", architecture="fake")
+    registry.register_model(cfg)
+    router = Router(registry, health=HealthConfig(**health_kw))
+    for i in range(n_workers):
+        router.register_worker(f"w{i}", "127.0.0.1", 10000 + i)
+        router.workers[f"w{i}"].health = WorkerHealth.HEALTHY
+    for s in range(n_shards):
+        registry.add_shard("m", "1.0", worker_id=f"w{s % n_workers}",
+                           shard_id=s, status=ModelStatus.READY)
+    return registry, router
+
+
+def test_routing_is_deterministic_per_key():
+    _, router = make_router()
+    first = router.route_request("m", "1.0", "session-42")
+    for _ in range(20):
+        again = router.route_request("m", "1.0", "session-42")
+        assert again.shard.shard_id == first.shard.shard_id
+        assert again.worker.worker_id == first.worker.worker_id
+        assert not again.failover
+
+
+def test_keys_spread_across_shards():
+    _, router = make_router(n_workers=3, n_shards=3)
+    hit = {router.route_request("m", "1.0", f"key-{i}").shard.shard_id
+           for i in range(200)}
+    assert hit == {0, 1, 2}
+
+
+def test_failover_is_deterministic_and_flagged():
+    _, router = make_router()
+    primary = router.route_request("m", "1.0", "sticky")
+    router.workers[primary.worker.worker_id].health = WorkerHealth.UNHEALTHY
+    alts = {router.route_request("m", "1.0", "sticky").shard.shard_id
+            for _ in range(20)}
+    assert len(alts) == 1                       # stable backup
+    assert alts.pop() != primary.shard.shard_id
+    assert router.route_request("m", "1.0", "sticky").failover
+    assert router.get_stats()["failover_count"] >= 1
+
+
+def test_failover_disabled_raises():
+    _, router = make_router(enable_failover=False)
+    primary = router.route_request("m", "1.0", "k")
+    router.workers[primary.worker.worker_id].health = WorkerHealth.UNHEALTHY
+    with pytest.raises(RoutingError, match="failover disabled"):
+        router.route_request("m", "1.0", "k")
+
+
+def test_no_healthy_shard_raises():
+    _, router = make_router()
+    for w in router.workers.values():
+        w.health = WorkerHealth.UNHEALTHY
+    with pytest.raises(RoutingError, match="no healthy shard"):
+        router.route_request("m", "1.0", "k")
+
+
+def test_unknown_model_raises():
+    _, router = make_router()
+    with pytest.raises(RoutingError, match="no shards"):
+        router.route_request("ghost", "1.0", "k")
+
+
+def test_failure_threshold_state_machine():
+    _, router = make_router(max_consecutive_failures=3)
+    router.mark_worker_failure("w0")
+    router.mark_worker_failure("w0")
+    assert router.workers["w0"].health is WorkerHealth.HEALTHY
+    router.mark_worker_failure("w0")
+    assert router.workers["w0"].health is WorkerHealth.UNHEALTHY
+    router.mark_worker_success("w0")            # re-admission
+    assert router.workers["w0"].health is WorkerHealth.HEALTHY
+    assert router.workers["w0"].consecutive_failures == 0
+
+
+async def test_live_probe_marks_health_and_recovers():
+    """Probe a real worker over RPC: up → healthy, down → unhealthy after
+    threshold, back up (new server, same port) → healthy again."""
+    registry = ModelRegistry()
+    router = Router(registry, health=HealthConfig(
+        check_timeout=1.0, max_consecutive_failures=2))
+    server = WorkerServer(ServerConfig(worker_id="wp", port=0))
+    host, port = await server.start()
+    router.register_worker("wp", host, port)
+    try:
+        assert await router.check_worker("wp") is True
+        assert router.workers["wp"].health is WorkerHealth.HEALTHY
+
+        await server.stop()
+        assert await router.check_worker("wp") is False
+        assert await router.check_worker("wp") is False
+        assert router.workers["wp"].health is WorkerHealth.UNHEALTHY
+
+        server2 = WorkerServer(ServerConfig(worker_id="wp", port=port,
+                                            host=host))
+        await server2.start()
+        try:
+            assert await router.check_worker("wp") is True
+            assert router.workers["wp"].health is WorkerHealth.HEALTHY
+        finally:
+            await server2.stop()
+    finally:
+        await router.stop()
+        await server.stop()
+
+
+async def test_health_loop_runs_and_stops():
+    registry = ModelRegistry()
+    router = Router(registry, health=HealthConfig(check_interval=0.05,
+                                                  check_timeout=0.5,
+                                                  max_consecutive_failures=1))
+    router.register_worker("dead", "127.0.0.1", 1)   # nothing listens there
+    await router.start()
+    try:
+        await asyncio.sleep(0.3)
+        assert router.workers["dead"].health is WorkerHealth.UNHEALTHY
+    finally:
+        await router.stop()
+    assert router._health_task is None

@@ -1,0 +1,157 @@
+"""Worker RPC server/client tests — framed round-trip, large payloads (the
+reference's 4 KiB truncation bug, ``src/worker.py:93``), persistent
+connections, model lifecycle, error fan-back, probe/request counter
+separation (SURVEY.md §5 pitfall)."""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.config import ModelConfig, ServerConfig
+from distributed_inference_engine_tpu.cluster.worker import (
+    WorkerClient,
+    WorkerRPCError,
+    WorkerServer,
+)
+
+
+def fake_cfg(name="echo", **meta):
+    return ModelConfig(name=name, architecture="fake", metadata=meta)
+
+
+async def start_worker(worker_id="w0", models=("echo",)):
+    server = WorkerServer(ServerConfig(worker_id=worker_id, port=0))
+    for m in models:
+        server.load_model(fake_cfg(m))
+    host, port = await server.start()
+    return server, WorkerClient(host, port, timeout=10.0)
+
+
+async def test_ping_and_generate_roundtrip():
+    server, client = await start_worker()
+    try:
+        pong = await client.ping()
+        assert pong["worker_id"] == "w0"
+        assert pong["models"] == ["echo"]
+
+        from distributed_inference_engine_tpu.engine.engine import GenerationRequest
+
+        results = await client.generate(
+            "echo", [GenerationRequest(prompt=[1, 2, 3], max_new_tokens=8,
+                                       request_id="r1")]
+        )
+        assert len(results) == 1
+        assert results[0].tokens == [3, 2, 1]       # FakeEngine reverses
+        assert results[0].request_id == "r1"
+        assert results[0].prompt_tokens == 3
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_large_payload_survives_framing():
+    """A prompt far beyond 4096 bytes must round-trip intact — the exact
+    failure mode of the reference's single read(4096)."""
+    server, client = await start_worker()
+    try:
+        from distributed_inference_engine_tpu.engine.engine import GenerationRequest
+
+        big = list(range(50_000))
+        results = await client.generate(
+            "echo", [GenerationRequest(prompt=big, max_new_tokens=50_000)]
+        )
+        assert results[0].tokens == list(reversed(big))
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_persistent_connection_many_calls():
+    server, client = await start_worker()
+    try:
+        from distributed_inference_engine_tpu.engine.engine import GenerationRequest
+
+        for i in range(5):
+            out = await client.generate(
+                "echo", [GenerationRequest(prompt=[i], max_new_tokens=1)]
+            )
+            assert out[0].tokens == [i]
+        # one connection serviced everything
+        assert server._active_connections == 1
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_unknown_model_and_method_errors():
+    server, client = await start_worker()
+    try:
+        from distributed_inference_engine_tpu.engine.engine import GenerationRequest
+
+        with pytest.raises(WorkerRPCError, match="not loaded"):
+            await client.generate("nope", [GenerationRequest(prompt=[1])])
+        with pytest.raises(WorkerRPCError, match="unknown method"):
+            await client.call("frobnicate")
+        # server kept serving after both errors
+        assert (await client.ping())["worker_id"] == "w0"
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_engine_error_fans_back_and_worker_survives():
+    server, client = await start_worker()
+    server.load_model(fake_cfg("flaky", error_rate=1.0))
+    try:
+        from distributed_inference_engine_tpu.engine.engine import GenerationRequest
+
+        with pytest.raises(WorkerRPCError, match="injected"):
+            await client.generate("flaky", [GenerationRequest(prompt=[1])])
+        assert server._error_count == 1
+        out = await client.generate("echo", [GenerationRequest(prompt=[7])])
+        assert out[0].tokens == [7]
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_model_lifecycle_over_rpc():
+    server, client = await start_worker(models=())
+    try:
+        await client.load_model(fake_cfg("m1"))
+        listed = await client.call("list_models")
+        assert "m1" in listed["models"]
+        assert await client.unload_model("m1") is True
+        assert await client.unload_model("m1") is False
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_probe_counters_separate_from_request_counters():
+    """Pings must not inflate generate stats (reference pitfall:
+    src/worker.py:87 counted probes as requests)."""
+    server, client = await start_worker()
+    try:
+        for _ in range(10):
+            await client.ping()
+        m = await client.metrics()
+        assert m["ping_count"] == 10
+        assert m["request_count"] == 0
+        assert m["models"]["echo"]["total_requests"] == 0
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_client_reconnects_after_drop():
+    server, client = await start_worker()
+    try:
+        await client.ping()
+        # forcibly kill the client's transport, then call again
+        client._writer.close()
+        pong = await client.ping()
+        assert pong["worker_id"] == "w0"
+    finally:
+        await client.close()
+        await server.stop()
