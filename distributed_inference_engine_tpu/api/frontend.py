@@ -19,15 +19,15 @@ import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ModelConfig, ServerConfig
-from ..utils.framing import FrameError, read_frame, write_frame
-from ..utils.rpc import FramedRPCClient
+from ..utils.rpc import FramedRPCClient, FramedServerMixin
 from .coordinator import Coordinator
 
 logger = logging.getLogger(__name__)
 
 
-class CoordinatorServer:
-    """Serves a ``Coordinator`` over framed RPC."""
+class CoordinatorServer(FramedServerMixin):
+    """Serves a ``Coordinator`` over framed RPC (connection loop + dispatch
+    envelope shared with ``WorkerServer`` via ``FramedServerMixin``)."""
 
     def __init__(self, coordinator: Coordinator,
                  config: Optional[ServerConfig] = None) -> None:
@@ -64,55 +64,14 @@ class CoordinatorServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            for w in list(self._conn_writers):  # see WorkerServer.stop
-                w.close()
+            self._close_all_connections()  # see WorkerServer.stop
             await self._server.wait_closed()
             self._server = None
         await self.coordinator.stop()
 
-    async def _handle_connection(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
-        self._conn_writers.add(writer)
-        try:
-            while True:
-                try:
-                    msg = await read_frame(reader,
-                                           max_frame=self.config.max_frame_bytes)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
-                    break
-                except FrameError as e:
-                    await write_frame(writer, {"success": False,
-                                               "error": f"bad frame: {e}"})
-                    break
-                # handle each request concurrently so one slow generate
-                # doesn't head-of-line-block other requests on the connection?
-                # no — responses must come back in frame order on one stream;
-                # concurrent clients should use concurrent connections.
-                response = await self._dispatch(msg)
-                await write_frame(writer, response)
-        finally:
-            self._conn_writers.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
-
-    async def _dispatch(self, msg: Any) -> Dict[str, Any]:
-        if not isinstance(msg, dict) or "method" not in msg:
-            return {"success": False, "error": "message must be a dict with 'method'"}
-        handler = self._methods.get(msg["method"])
-        req_id = msg.get("id", "")
-        if handler is None:
-            return {"id": req_id, "success": False,
-                    "error": f"unknown method {msg['method']!r}"}
-        try:
-            result = await handler(msg)
-            return {"id": req_id, "success": True, "result": result}
-        except Exception as e:
-            logger.warning("coordinator: %s failed: %s", msg["method"], e)
-            return {"id": req_id, "success": False, "error": str(e)}
+    @property
+    def max_frame_bytes(self) -> int:
+        return self.config.max_frame_bytes
 
     # -- methods ------------------------------------------------------------
 

@@ -187,13 +187,18 @@ class Router:
 
     def _find_alternative_shard(
         self, model: str, version: str, key: str, exclude: int,
+        exclude_worker: Optional[str] = None,
     ) -> Optional[ModelShard]:
         """Deterministic backup: hash(key) mod healthy-shard-count
         (reference ``src/router.py:186-221``) — stable per key, so failover
-        keeps prefix-cache affinity too."""
+        keeps prefix-cache affinity too. ``exclude_worker`` drops every shard
+        hosted by that worker (transport-failure retry must not land on
+        another shard of the same dead host)."""
         healthy: List[ModelShard] = []
         for shard in self.registry.all_shards(model, version):
             if shard.shard_id == exclude:
+                continue
+            if exclude_worker is not None and shard.worker_id == exclude_worker:
                 continue
             w = self.workers.get(shard.worker_id)
             if w is not None and w.health is not WorkerHealth.UNHEALTHY:

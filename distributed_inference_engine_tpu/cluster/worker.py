@@ -37,7 +37,7 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 from ..config import ModelConfig, ServerConfig
 from ..engine.types import GenerationRequest, GenerationResult
 from ..utils.framing import FrameError, read_frame, write_frame
-from ..utils.rpc import FramedRPCClient, RPCError
+from ..utils.rpc import FramedRPCClient, FramedServerMixin, RPCError
 from ..utils.tracing import LatencyStats
 
 logger = logging.getLogger(__name__)
@@ -113,8 +113,12 @@ EngineFactory = Callable[[ModelConfig], Any]
 # --------------------------------------------------------------------------
 # server
 
-class WorkerServer:
-    """Framed-RPC worker host (heir of reference ``Worker``, src/worker.py:26-209)."""
+class WorkerServer(FramedServerMixin):
+    """Framed-RPC worker host (heir of reference ``Worker``, src/worker.py:26-209).
+
+    Connection loop + dispatch envelope live in ``FramedServerMixin``
+    (shared with ``CoordinatorServer``); this class supplies the worker
+    policy via the mixin hooks."""
 
     def __init__(
         self,
@@ -177,8 +181,7 @@ class WorkerServer:
             self._server.close()
             # persistent connections never exit on their own — close them, or
             # wait_closed() (which awaits all handlers on py3.12+) never returns
-            for w in list(self._conn_writers):
-                w.close()
+            self._close_all_connections()
             await self._server.wait_closed()
             self._server = None
         self._executor.shutdown(wait=False, cancel_futures=True)
@@ -210,80 +213,53 @@ class WorkerServer:
         logger.info("worker %s unloaded model %s", self.worker_id, name)
         return True
 
-    # -- connection handling -------------------------------------------------
+    # -- connection handling (loop + envelope in FramedServerMixin) -----------
+
+    @property
+    def max_frame_bytes(self) -> int:
+        return self.config.max_frame_bytes
 
     async def _handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
         self._active_connections += 1
-        self._conn_writers.add(writer)
         try:
-            while True:
-                try:
-                    msg = await read_frame(
-                        reader,
-                        max_frame=self.config.max_frame_bytes,
-                        timeout=None,
-                    )
-                except (asyncio.IncompleteReadError, ConnectionResetError):
-                    break  # client closed
-                except FrameError as e:
-                    await write_frame(writer, {"success": False,
-                                               "error": f"bad frame: {e}"})
-                    break
-                response = await self._dispatch(msg)
-                await write_frame(writer, response)
+            await super()._handle_connection(reader, writer)
         finally:
             self._active_connections -= 1
-            self._conn_writers.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
             logger.debug("worker %s connection from %s closed",
                          self.worker_id, peer)
 
-    async def _dispatch(self, msg: Any) -> Dict[str, Any]:
-        t0 = time.perf_counter()
-        if not isinstance(msg, dict) or "method" not in msg:
-            return {"success": False, "error": "message must be a dict with 'method'"}
-        method = msg["method"]
-        handler = self._methods.get(method)
-        req_id = msg.get("id", "")
-        if handler is None:
-            return {"id": req_id, "success": False,
-                    "error": f"unknown method {method!r}"}
-        try:
-            # generate/load_model legitimately run for minutes (first-call XLA
-            # compile, checkpoint load) — their deadline belongs to the caller.
-            # The server-side timeout only guards the cheap control methods.
-            if method in ("generate", "load_model"):
-                result = await handler(msg)
-            else:
-                result = await asyncio.wait_for(
-                    handler(msg), timeout=self.config.request_timeout
-                )
-            response = {"id": req_id, "success": True,
-                        "worker_id": self.worker_id, "result": result}
-        except asyncio.TimeoutError:
-            # only control methods are wait_for-wrapped, so this is probe
-            # trouble, not a generate failure — keep it out of _error_count
-            response = {"id": req_id, "success": False, "worker_id": self.worker_id,
-                        "error": f"request timed out after {self.config.request_timeout}s"}
-        except Exception as e:  # fan any handler error back, keep serving
-            if method == "generate":
-                self._error_count += 1
-            logger.warning("worker %s: %s failed: %s", self.worker_id, method, e)
-            response = {"id": req_id, "success": False,
-                        "worker_id": self.worker_id, "error": str(e)}
-        dur_ms = (time.perf_counter() - t0) * 1e3
+    async def _run_handler(self, method: str, handler, msg) -> Any:
+        # generate/load_model legitimately run for minutes (first-call XLA
+        # compile, checkpoint load) — their deadline belongs to the caller.
+        # The server-side timeout only guards the cheap control methods.
+        if method in ("generate", "load_model"):
+            return await handler(msg)
+        return await asyncio.wait_for(
+            handler(msg), timeout=self.config.request_timeout
+        )
+
+    def _envelope_extra(self) -> Dict[str, Any]:
+        return {"worker_id": self.worker_id}
+
+    def _timeout_error(self, method: str) -> str:
+        # only control methods are wait_for-wrapped, so a timeout is probe
+        # trouble, not a generate failure — it stays out of _error_count
+        return f"request timed out after {self.config.request_timeout}s"
+
+    def _on_handler_error(self, method: str, exc: Exception) -> None:
         if method == "generate":
-            self.latency.add(dur_ms / 1e3)
+            self._error_count += 1
+
+    def _after_dispatch(self, method: str, req_id: str,
+                        duration_s: float, response: Dict[str, Any]) -> None:
+        if method == "generate":
+            self.latency.add(duration_s)
             logger.info("worker %s: generate id=%s %.1fms ok=%s",
-                        self.worker_id, req_id, dur_ms, response["success"])
-        return response
+                        self.worker_id, req_id, duration_s * 1e3,
+                        response["success"])
 
     # -- RPC methods ---------------------------------------------------------
 

@@ -1,0 +1,184 @@
+"""Paged HBM KV cache: fixed-size page pool + per-slot page tables.
+
+The full realisation of BASELINE.json's north star for the reference's
+``src/kvstore.py`` ("repurposed as an HBM-resident paged KV cache with LRU
+eviction"): instead of one contiguous ``max_seq_len`` row per slot
+(``SlotKVCache``), attention state lives in a shared pool of
+``page_size``-token pages. Short sequences hold few pages, long ones many;
+freeing a sequence returns its pages to the pool immediately (the recycling
+that LRU-evicting whole rows only approximates).
+
+Split of responsibilities:
+
+- **Host (this class):** page accounting — free list, per-slot page lists,
+  capacity reservations. Pure Python, mirrors the reference's free-list slot
+  discipline (``src/kvstore.py:82-102``'s eviction loop becomes page
+  recycling).
+- **Device:** ``k_pages``/``v_pages`` ``[L, num_pages, page_size, Hkv*Dh]``
+  and an int32 ``page_table`` ``[max_slots, max_pages_per_seq]`` that jitted
+  decode indexes through (``ops/paged_attention.py``). The table is rebuilt
+  on device only when host accounting changes (admission / page growth), so
+  steady-state decode does zero host→device traffic for metadata.
+
+Chunked-decode contract: callers must ``reserve(slot, n_tokens)`` the whole
+chunk before launching it — the table is static while the chunk runs, so page
+boundaries crossed mid-chunk already have physical pages behind them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.base import ModelSpec
+
+
+class OutOfPagesError(RuntimeError):
+    """Pool exhausted — the scheduler must queue or preempt."""
+
+
+class PagedKVCache:
+    """Host-side page allocator + device-side page pool for one model."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        max_slots: int,
+        page_size: int = 128,
+        num_pages: int = 512,
+        max_seq_len: Optional[int] = None,
+        dtype: Optional[str] = None,
+    ) -> None:
+        fused = spec.n_kv_heads * spec.head_dim
+        if fused % 128:
+            raise ValueError(
+                f"n_kv_heads*head_dim = {fused} must be a multiple of 128 "
+                "for the paged layout (TPU lane alignment)"
+            )
+        self.spec = spec
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_seq_len = max_seq_len or spec.max_seq_len
+        self.max_pages_per_seq = -(-self.max_seq_len // page_size)
+        self.dtype = jnp.dtype(dtype) if dtype else spec.jnp_dtype
+
+        shape = (spec.n_layers, num_pages, page_size, fused)
+        self.k_pages = jnp.zeros(shape, dtype=self.dtype)
+        self.v_pages = jnp.zeros(shape, dtype=self.dtype)
+
+        self._free: List[int] = list(range(num_pages))
+        self._slot_pages: Dict[int, List[int]] = {}   # slot -> physical pages
+        self._slot_len: Dict[int, int] = {}           # slot -> reserved tokens
+        self._free_slots: List[int] = list(range(max_slots))
+        self._table = np.zeros((max_slots, self.max_pages_per_seq), dtype=np.int32)
+        self._table_dirty = True
+        self._table_dev: Optional[jnp.ndarray] = None
+        self._peak_pages_used = 0
+
+    # ------------------------------------------------------------ slots
+
+    def alloc_slot(self, n_tokens: int) -> Optional[int]:
+        """Claim a slot with capacity for ``n_tokens``; None if no slot or
+        not enough pages (caller queues the request)."""
+        need = self._pages_for(n_tokens)
+        if not self._free_slots or len(self._free) < need:
+            return None
+        slot = self._free_slots.pop(0)
+        pages = [self._free.pop(0) for _ in range(need)]
+        self._slot_pages[slot] = pages
+        self._slot_len[slot] = n_tokens
+        self._table[slot, : len(pages)] = pages
+        self._table[slot, len(pages):] = 0
+        self._table_dirty = True
+        used = self.num_pages - len(self._free)
+        self._peak_pages_used = max(self._peak_pages_used, used)
+        return slot
+
+    def reserve(self, slot: int, n_tokens: int) -> int:
+        """Grow the slot by up to ``n_tokens`` more tokens of capacity.
+
+        Returns the number of tokens actually granted — less than
+        ``n_tokens`` when ``max_seq_len`` truncates the request, ``0`` when
+        the page pool can't cover it. Callers running a decode chunk must
+        bound the chunk's steps by the grant (SURVEY.md §7 hard-part #2:
+        positions past the grant would index past the page table's width)."""
+        if slot not in self._slot_pages:
+            raise KeyError(f"slot {slot} not live")
+        total = min(self._slot_len[slot] + n_tokens, self.max_seq_len)
+        granted = total - self._slot_len[slot]
+        if granted <= 0:
+            return 0
+        need = self._pages_for(total) - len(self._slot_pages[slot])
+        if need <= 0:
+            self._slot_len[slot] = total
+            return granted
+        if len(self._free) < need:
+            return 0
+        pages = [self._free.pop(0) for _ in range(need)]
+        cur = self._slot_pages[slot]
+        self._table[slot, len(cur): len(cur) + len(pages)] = pages
+        cur.extend(pages)
+        self._slot_len[slot] = total
+        self._table_dirty = True
+        used = self.num_pages - len(self._free)
+        self._peak_pages_used = max(self._peak_pages_used, used)
+        return granted
+
+    def free_slot(self, slot: int) -> None:
+        pages = self._slot_pages.pop(slot, None)
+        if pages is None:
+            return
+        self._free.extend(pages)
+        del self._slot_len[slot]
+        self._free_slots.append(slot)
+        self._table[slot, :] = 0
+        self._table_dirty = True
+
+    def _pages_for(self, n_tokens: int) -> int:
+        return max(1, -(-n_tokens // self.page_size))
+
+    # ----------------------------------------------------------- device
+
+    @property
+    def page_table(self) -> jnp.ndarray:
+        """Device copy of the table; re-uploaded only after host changes."""
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = jnp.asarray(self._table)
+            self._table_dirty = False
+        return self._table_dev
+
+    def swap(self, new_k: jnp.ndarray, new_v: jnp.ndarray) -> None:
+        """Adopt page pools returned by a jitted (donating) decode step."""
+        self.k_pages, self.v_pages = new_k, new_v
+
+    # ------------------------------------------------------------ stats
+
+    @property
+    def n_free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_free_slots(self) -> int:
+        return len(self._free_slots)
+
+    def slot_capacity(self, slot: int) -> int:
+        return len(self._slot_pages[slot]) * self.page_size
+
+    def get_stats(self) -> Dict[str, float]:
+        bytes_total = 2 * self.k_pages.size * self.k_pages.dtype.itemsize
+        used = self.num_pages - len(self._free)
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "pages_used": used,
+            "pages_free": len(self._free),
+            "peak_pages_used": self._peak_pages_used,
+            "utilization": used / self.num_pages if self.num_pages else 0.0,
+            "live_slots": len(self._slot_pages),
+            "free_slots": len(self._free_slots),
+            "hbm_bytes": bytes_total,
+            "hbm_gib": bytes_total / (1 << 30),
+        }

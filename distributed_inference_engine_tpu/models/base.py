@@ -281,6 +281,98 @@ def forward_decode(
     return x[:, 0, :], new_k, new_v
 
 
+# ------------------------------------------------------------ paged decode
+
+
+def forward_decode_paged(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,      # [B] the most recent token per slot
+    lengths: jnp.ndarray,     # [B] current length per slot (position of `tokens`)
+    k_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh] page pools
+    v_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh]
+    page_table: jnp.ndarray,  # [B, MP] int32 logical->physical pages
+    *,
+    attn_impl: str = "auto",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step against the paged HBM cache (``engine/paged_kv.py``).
+
+    Each slot's fresh K/V is scattered into its page at position ``lengths``
+    (page = lengths // P, offset = lengths % P — capacity must be reserved
+    before the chunk, see ``PagedKVCache.reserve``), then attention runs over
+    the slot's live pages via ``ops/paged_attention.py``. Returns
+    (hidden [B, D], new k_pages, new v_pages).
+    """
+    from ..ops.paged_attention import paged_attention
+
+    b = tokens.shape[0]
+    page_size = k_pages.shape[2]
+    positions = lengths[:, None]                         # [B, 1]
+    x = embed(spec, params, tokens[:, None], positions)  # [B, 1, D]
+    batch_idx = jnp.arange(b)
+    logical = lengths // page_size
+    offset = lengths % page_size
+    phys = page_table[batch_idx, logical]                # [B]
+
+    def body(x, per_layer):
+        blk, kp, vp = per_layer
+        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+        q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
+        fused = k.shape[2] * k.shape[3]
+        kp = kp.at[phys, offset].set(k[:, 0].reshape(b, fused).astype(kp.dtype))
+        vp = vp.at[phys, offset].set(v[:, 0].reshape(b, fused).astype(vp.dtype))
+        attn = paged_attention(
+            q[:, 0], kp, vp, page_table, lengths + 1,
+            n_kv_heads=spec.n_kv_heads, impl=attn_impl,
+        )
+        x = x + _out_proj(spec, blk, attn[:, None])
+        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+        x = x + _mlp(spec, blk, h2)
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], k_pages, v_pages))
+    return x[:, 0, :], new_k, new_v
+
+
+def write_prefill_pages(
+    k_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh]
+    v_pages: jnp.ndarray,
+    ks: jnp.ndarray,          # [L, B, T, Hkv, Dh] fresh prefill K/V
+    vs: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MP]
+    seq_lens: jnp.ndarray,    # [B] valid prompt lengths
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter prefilled K/V into page pools. Per layer this is ONE flat
+    scatter: each valid token's (physical page, offset) flattens to an index
+    into the pool viewed as [num_pages * page_size, fused]; padded positions
+    get an out-of-range index and ``mode="drop"`` discards them."""
+    L, B, T, Hkv, Dh = ks.shape
+    page_size = k_pages.shape[2]
+    fused = Hkv * Dh
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))        # [B, T]
+    valid = pos < seq_lens[:, None]
+    logical = pos // page_size
+    offset = pos % page_size
+    phys = jnp.take_along_axis(
+        page_table, jnp.minimum(logical, page_table.shape[1] - 1), axis=1
+    )                                                              # [B, T]
+    n, p = k_pages.shape[1], k_pages.shape[2]
+    flat_idx = jnp.where(valid, phys * page_size + offset, n * p)  # oob -> drop
+
+    def per_layer(_, xs):
+        kp, vp, fk, fv = xs
+        kp = kp.reshape(n * p, fused).at[flat_idx].set(
+            fk.reshape(B, T, fused).astype(kp.dtype), mode="drop"
+        ).reshape(n, p, fused)
+        vp = vp.reshape(n * p, fused).at[flat_idx].set(
+            fv.reshape(B, T, fused).astype(vp.dtype), mode="drop"
+        ).reshape(n, p, fused)
+        return None, (kp, vp)
+
+    _, (k_pages, v_pages) = lax.scan(per_layer, None, (k_pages, v_pages, ks, vs))
+    return k_pages, v_pages
+
+
 # ---------------------------------------------------------------- training
 
 

@@ -1,0 +1,244 @@
+"""Paged decode attention: Pallas TPU kernel + XLA reference implementation.
+
+This is the TPU-native answer to SURVEY.md §7 hard-part #2 (paged KV cache in
+HBM) and the north-star reinterpretation of the reference's ``src/kvstore.py``
+cache: attention state lives in a pool of fixed-size HBM pages instead of one
+contiguous row per sequence, so long and short sequences share HBM without
+fragmentation and page recycling replaces whole-row eviction.
+
+Layout (per layer):
+
+- ``k_pages`` / ``v_pages``: ``[num_pages, page_size, n_kv * head_dim]`` —
+  the trailing dim is fused so every VMEM block is lane-aligned (the kernel
+  requires ``n_kv * head_dim`` to be a multiple of 128, the TPU lane count).
+- ``page_table``: ``[batch, max_pages_per_seq]`` int32 — logical page ``p`` of
+  slot ``b`` lives in physical page ``page_table[b, p]``. Unused entries must
+  hold a valid page id (0): the kernel still DMAs them (static grid) and masks
+  the scores, so the id only has to be safe to read.
+- ``lengths``: ``[batch]`` int32 — live tokens per slot, *including* the
+  token at the current decode position.
+
+Kernel design (flash-style online softmax over pages):
+
+- Grid ``(batch, max_pages_per_seq)``; the page table and lengths ride
+  ``PrefetchScalarGridSpec`` so the index map can translate logical→physical
+  page ids before the block DMA is issued — the gather lives in the DMA
+  engine, not in compute.
+- Per grid step one K page and one V page are DMA'd to VMEM (double-buffered
+  by the Pallas pipeline across the sequential page axis), scores are computed
+  on the MXU in fp32, and VMEM scratch carries the running (max, sum, acc)
+  across pages of the same row.
+- GQA without materialization: Q is reshaped ``[n_kv, group, head_dim]`` and
+  contracted per kv-head, so grouped queries share one K/V load.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------- XLA path
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,            # [B, H, Dh]
+    k_pages: jnp.ndarray,      # [N, P, Hkv * Dh]
+    v_pages: jnp.ndarray,      # [N, P, Hkv * Dh]
+    page_table: jnp.ndarray,   # [B, MP] int32
+    lengths: jnp.ndarray,      # [B] int32
+    *,
+    n_kv_heads: int,
+) -> jnp.ndarray:
+    """Reference implementation via gather; correct everywhere (CPU tests,
+    interpret-mode cross-check), but reads the whole gathered cache through
+    XLA's generic scatter/gather path. Returns [B, H, Dh] in q.dtype."""
+    b, h, dh = q.shape
+    n, p, fused = k_pages.shape
+    mp = page_table.shape[1]
+    g = h // n_kv_heads
+
+    k = k_pages[page_table]                       # [B, MP, P, Hkv*Dh]
+    v = v_pages[page_table]
+    k = k.reshape(b, mp * p, n_kv_heads, dh)      # [B, S, Hkv, Dh]
+    v = v.reshape(b, mp * p, n_kv_heads, dh)
+
+    qg = q.reshape(b, n_kv_heads, g, dh)
+    scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k).astype(jnp.float32) * scale
+    valid = jnp.arange(mp * p)[None, :] < lengths[:, None]        # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, h, dh).astype(q.dtype)
+
+
+# -------------------------------------------------------------- Pallas path
+
+
+def _paged_attn_kernel(
+    # scalar prefetch
+    page_table_ref,            # [B, MP] SMEM
+    lengths_ref,               # [B] SMEM
+    # blocks
+    q_ref,                     # [1, H * Dh] VMEM
+    k_ref,                     # [1, P, Hkv * Dh] VMEM (one physical page)
+    v_ref,                     # [1, P, Hkv * Dh] VMEM
+    out_ref,                   # [1, H * Dh] VMEM
+    # scratch
+    m_scr,                     # [H, 128] f32
+    l_scr,                     # [H, 128] f32
+    acc_scr,                   # [H, Dh] f32
+    *,
+    n_kv_heads: int,
+    head_dim: int,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    length = lengths_ref[b]
+    dh = head_dim
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages past the live prefix contribute nothing; skip their FLOPs
+    live = p * page_size < length
+
+    @pl.when(live)
+    def _page():
+        h_total = q_ref.shape[1] // dh
+        g = h_total // n_kv_heads
+        q = q_ref[0, :].reshape(n_kv_heads, g, dh)            # [Hkv, G, Dh]
+        k = k_ref[0].reshape(page_size, n_kv_heads, dh)       # [P, Hkv, Dh]
+        v = v_ref[0].reshape(page_size, n_kv_heads, dh)
+
+        # scores [Hkv, G, P]: contract Dh, batch over Hkv (MXU, fp32 accum)
+        scores = lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * (1.0 / (dh ** 0.5))
+
+        tok = p * page_size + lax.broadcasted_iota(
+            jnp.int32, (n_kv_heads, g, page_size), 2
+        )
+        scores = jnp.where(tok < length, scores, NEG_INF)
+        scores = scores.reshape(h_total, page_size)           # [H, P]
+
+        m_prev = m_scr[:, 0][:, None]                         # [H, 1]
+        l_prev = l_scr[:, 0][:, None]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                       # [H, 1]
+        probs = jnp.exp(scores - m_new)                       # [H, P]
+        l_new = l_prev * alpha + probs.sum(axis=-1, keepdims=True)
+
+        # pv [Hkv, G, Dh]: contract P, batch over Hkv
+        pv = lax.dot_general(
+            probs.reshape(n_kv_heads, g, page_size),
+            v.astype(jnp.float32),
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(h_total, dh)
+
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        h_total = q_ref.shape[1] // dh
+        l = jnp.maximum(l_scr[:, 0][:, None], 1e-30)          # [H, 1]
+        out = (acc_scr[:] / l).reshape(1, h_total * dh)
+        out_ref[:] = out.astype(out_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,            # [B, H, Dh]
+    k_pages: jnp.ndarray,      # [N, P, Hkv * Dh]
+    v_pages: jnp.ndarray,      # [N, P, Hkv * Dh]
+    page_table: jnp.ndarray,   # [B, MP] int32
+    lengths: jnp.ndarray,      # [B] int32
+    *,
+    n_kv_heads: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, h, dh = q.shape
+    n, page_size, fused = k_pages.shape
+    mp = page_table.shape[1]
+    if fused != n_kv_heads * dh:
+        raise ValueError(f"fused dim {fused} != n_kv_heads*head_dim {n_kv_heads * dh}")
+    if fused % 128:
+        raise ValueError(
+            f"n_kv_heads*head_dim = {fused} must be a multiple of 128 (TPU lanes)"
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mp),
+        in_specs=[
+            pl.BlockSpec((1, h * dh), lambda i, p, pt, ln: (i, 0)),
+            pl.BlockSpec((1, page_size, fused), lambda i, p, pt, ln: (pt[i, p], 0, 0)),
+            pl.BlockSpec((1, page_size, fused), lambda i, p, pt, ln: (pt[i, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h * dh), lambda i, p, pt, ln: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attn_kernel,
+        n_kv_heads=n_kv_heads,
+        head_dim=dh,
+        page_size=page_size,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h * dh), q.dtype),
+        interpret=interpret,
+    )(page_table, lengths, q.reshape(b, h * dh), k_pages, v_pages)
+    return out.reshape(b, h, dh)
+
+
+# ------------------------------------------------------------- dispatcher
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    n_kv_heads: int,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """impl: "auto" (pallas on TPU, xla elsewhere) | "xla" | "pallas" |
+    "pallas_interpret" (kernel correctness tests on CPU)."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return paged_attention_xla(
+            q, k_pages, v_pages, page_table, lengths, n_kv_heads=n_kv_heads
+        )
+    if impl in ("pallas", "pallas_interpret"):
+        return paged_attention_pallas(
+            q, k_pages, v_pages, page_table, lengths,
+            n_kv_heads=n_kv_heads, interpret=impl == "pallas_interpret",
+        )
+    raise ValueError(f"unknown paged-attention impl {impl!r}")

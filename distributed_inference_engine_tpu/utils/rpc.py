@@ -1,17 +1,22 @@
-"""Shared framed-RPC client plumbing.
+"""Shared framed-RPC plumbing: client class + server connection loop.
 
 One implementation of connect/reconnect/locking/call for every framed-RPC
 peer (worker client, coordinator client) — the reference had no client class
 at all, and two hand-rolled copies would drift (they briefly did: one copy
-lost the malformed-response guard).
+lost the malformed-response guard; later the two hand-rolled *server* loops
+drifted the same way, hence ``FramedServerMixin``).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, Optional
+import logging
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
-from .framing import read_frame, write_frame
+from .framing import FrameError, read_frame, write_frame
+
+logger = logging.getLogger(__name__)
 
 
 class RPCError(RuntimeError):
@@ -82,3 +87,100 @@ class FramedRPCClient:
         if not response.get("success"):
             raise RPCError(response.get("error", "unknown peer error"))
         return response.get("result")
+
+
+class FramedServerMixin:
+    """Framed-RPC server connection loop, shared by ``WorkerServer`` and
+    ``CoordinatorServer``.
+
+    Subclass contract: set ``self._methods`` (method name → async handler)
+    and ``self._conn_writers`` (a set) before serving, expose
+    ``self.max_frame_bytes``. Responses come back in frame order on one
+    stream; concurrent clients use concurrent connections.
+
+    Hooks (all optional overrides):
+    - ``_run_handler(method, handler, msg)`` — server-side timeout policy.
+    - ``_envelope_extra()`` — dict merged into every response envelope.
+    - ``_timeout_error(method)`` — message for ``asyncio.TimeoutError``.
+    - ``_on_handler_error(method, exc)`` — error accounting.
+    - ``_after_dispatch(method, req_id, duration_s, response)`` — metrics.
+    """
+
+    _methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]]
+    _conn_writers: set
+    max_frame_bytes: int = 64 * 1024 * 1024
+
+    async def _handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conn_writers.add(writer)
+        try:
+            while True:
+                try:
+                    msg = await read_frame(
+                        reader, max_frame=self.max_frame_bytes, timeout=None
+                    )
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break  # client closed
+                except FrameError as e:
+                    await write_frame(writer, {"success": False,
+                                               "error": f"bad frame: {e}"})
+                    break
+                response = await self._dispatch(msg)
+                await write_frame(writer, response)
+        finally:
+            self._conn_writers.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _dispatch(self, msg: Any) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        if not isinstance(msg, dict) or "method" not in msg:
+            return {"success": False,
+                    "error": "message must be a dict with 'method'"}
+        method = msg["method"]
+        handler = self._methods.get(method)
+        req_id = msg.get("id", "")
+        extra = self._envelope_extra()
+        if handler is None:
+            return {"id": req_id, "success": False, **extra,
+                    "error": f"unknown method {method!r}"}
+        try:
+            result = await self._run_handler(method, handler, msg)
+            response = {"id": req_id, "success": True, **extra,
+                        "result": result}
+        except asyncio.TimeoutError:
+            response = {"id": req_id, "success": False, **extra,
+                        "error": self._timeout_error(method)}
+        except Exception as e:  # fan any handler error back, keep serving
+            self._on_handler_error(method, e)
+            logger.warning("%s: %s failed: %s",
+                           type(self).__name__, method, e)
+            response = {"id": req_id, "success": False, **extra,
+                        "error": str(e)}
+        self._after_dispatch(method, req_id, time.perf_counter() - t0,
+                             response)
+        return response
+
+    async def _run_handler(self, method: str, handler, msg) -> Any:
+        return await handler(msg)
+
+    def _envelope_extra(self) -> Dict[str, Any]:
+        return {}
+
+    def _timeout_error(self, method: str) -> str:
+        return f"{method} timed out"
+
+    def _on_handler_error(self, method: str, exc: Exception) -> None:
+        pass
+
+    def _after_dispatch(self, method: str, req_id: str,
+                        duration_s: float, response: Dict[str, Any]) -> None:
+        pass
+
+    def _close_all_connections(self) -> None:
+        for w in list(self._conn_writers):
+            w.close()
