@@ -130,6 +130,7 @@ class WorkerServer(FramedServerMixin):
         self.engine_factory = engine_factory
         self.engines: Dict[str, Any] = {}
         self.model_configs: Dict[str, ModelConfig] = {}
+        self._pumps: Dict[str, Any] = {}    # model -> EnginePump (continuous)
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_writers: set = set()
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -184,6 +185,8 @@ class WorkerServer(FramedServerMixin):
             self._close_all_connections()
             await self._server.wait_closed()
             self._server = None
+        for pump in self._pumps.values():
+            pump.shutdown_nowait()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self._shutdown_event.set()
         logger.info("worker %s stopped", self.worker_id)
@@ -199,8 +202,14 @@ class WorkerServer(FramedServerMixin):
         if cfg.name in self.engines:
             raise ValueError(f"model {cfg.name!r} already loaded")
         t0 = time.perf_counter()
-        self.engines[cfg.name] = self.engine_factory(cfg)
+        engine = self.engine_factory(cfg)
+        self.engines[cfg.name] = engine
         self.model_configs[cfg.name] = cfg
+        # continuous engines get a rolling-batch pump (serving/pump.py)
+        if hasattr(engine, "submit") and hasattr(engine, "step"):
+            from ..serving.pump import EnginePump
+
+            self._pumps[cfg.name] = EnginePump(engine)
         logger.info("worker %s loaded model %s (%s) in %.2fs",
                     self.worker_id, cfg.name, cfg.architecture,
                     time.perf_counter() - t0)
@@ -208,6 +217,9 @@ class WorkerServer(FramedServerMixin):
     def unload_model(self, name: str) -> bool:
         engine = self.engines.pop(name, None)
         self.model_configs.pop(name, None)
+        pump = self._pumps.pop(name, None)
+        if pump is not None:
+            pump.shutdown_nowait()
         if engine is None:
             return False
         logger.info("worker %s unloaded model %s", self.worker_id, name)
@@ -280,10 +292,17 @@ class WorkerServer(FramedServerMixin):
         if not reqs:
             raise ValueError("empty 'requests'")
         self._request_count += 1
-        loop = asyncio.get_running_loop()
-        results = await loop.run_in_executor(
-            self._executor, engine.generate, reqs
-        )
+        pump = self._pumps.get(name)
+        if pump is not None:
+            # continuous engine: requests join the rolling decode batch —
+            # concurrent connections share chunks instead of serializing
+            # whole generations behind the executor
+            results = await pump.generate(reqs)
+        else:
+            loop = asyncio.get_running_loop()
+            results = await loop.run_in_executor(
+                self._executor, engine.generate, reqs
+            )
         return {"model": name, "results": [result_to_dict(r) for r in results]}
 
     async def _rpc_load_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:

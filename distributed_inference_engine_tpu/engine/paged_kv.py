@@ -127,6 +127,31 @@ class PagedKVCache:
         self._peak_pages_used = max(self._peak_pages_used, used)
         return granted
 
+    def ensure_capacity(self, slot: int, total_tokens: int) -> int:
+        """Best-effort growth toward ``total_tokens`` of total capacity.
+
+        Unlike ``reserve`` (all-or-nothing increments), this takes as many
+        pages as the pool can spare and returns the slot's resulting token
+        capacity (clamped to ``max_seq_len``) — the continuous engine bounds
+        its decode chunk by this, so pool pressure shortens chunks instead
+        of failing them."""
+        if slot not in self._slot_pages:
+            raise KeyError(f"slot {slot} not live")
+        target = min(total_tokens, self.max_seq_len)
+        pages = self._slot_pages[slot]
+        need = self._pages_for(target) - len(pages)
+        take = min(max(need, 0), len(self._free))
+        if take > 0:
+            fresh = [self._free.pop(0) for _ in range(take)]
+            self._table[slot, len(pages): len(pages) + take] = fresh
+            pages.extend(fresh)
+            self._table_dirty = True
+            used = self.num_pages - len(self._free)
+            self._peak_pages_used = max(self._peak_pages_used, used)
+        cap = min(len(pages) * self.page_size, self.max_seq_len)
+        self._slot_len[slot] = max(self._slot_len[slot], min(target, cap))
+        return cap
+
     def free_slot(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, None)
         if pages is None:
@@ -144,9 +169,12 @@ class PagedKVCache:
 
     @property
     def page_table(self) -> jnp.ndarray:
-        """Device copy of the table; re-uploaded only after host changes."""
+        """Device copy of the table; re-uploaded only after host changes.
+        ``jnp.array`` (not ``asarray``): on CPU backends asarray may
+        zero-copy-alias the mutable host table, making the "snapshot" track
+        live host mutations."""
         if self._table_dirty or self._table_dev is None:
-            self._table_dev = jnp.asarray(self._table)
+            self._table_dev = jnp.array(self._table)
             self._table_dirty = False
         return self._table_dev
 

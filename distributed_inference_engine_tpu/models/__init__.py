@@ -78,4 +78,12 @@ def engine_from_config(cfg):
         params = None
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
+    for k in ("page_size", "num_pages", "decode_steps_per_call",
+              "attention_impl", "kv_dtype"):
+        if k in cfg.metadata:
+            setattr(ecfg, k, cfg.metadata[k])
+    if cfg.metadata.get("continuous"):
+        from ..engine.continuous import ContinuousEngine
+
+        return ContinuousEngine(spec, params=params, config=ecfg)
     return Engine(spec, params=params, config=ecfg)

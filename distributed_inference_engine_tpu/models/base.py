@@ -292,6 +292,7 @@ def forward_decode_paged(
     k_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh] page pools
     v_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh]
     page_table: jnp.ndarray,  # [B, MP] int32 logical->physical pages
+    write_mask: Optional[jnp.ndarray] = None,   # [B] bool: which slots write
     *,
     attn_impl: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -302,10 +303,16 @@ def forward_decode_paged(
     before the chunk, see ``PagedKVCache.reserve``), then attention runs over
     the slot's live pages via ``ops/paged_attention.py``. Returns
     (hidden [B, D], new k_pages, new v_pages).
+
+    ``write_mask`` exists because decode always runs over ALL slots (static
+    shapes): an inactive slot's page table points at physical page 0, which
+    belongs to some live slot — its K/V write must be dropped, not landed.
+    Masked-off slots get an out-of-range scatter index (``mode="drop"``).
     """
     from ..ops.paged_attention import paged_attention
 
     b = tokens.shape[0]
+    n_pages = k_pages.shape[1]
     page_size = k_pages.shape[2]
     positions = lengths[:, None]                         # [B, 1]
     x = embed(spec, params, tokens[:, None], positions)  # [B, 1, D]
@@ -313,14 +320,18 @@ def forward_decode_paged(
     logical = lengths // page_size
     offset = lengths % page_size
     phys = page_table[batch_idx, logical]                # [B]
+    if write_mask is not None:
+        phys = jnp.where(write_mask, phys, n_pages)      # oob -> dropped
 
     def body(x, per_layer):
         blk, kp, vp = per_layer
         h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
         q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
         fused = k.shape[2] * k.shape[3]
-        kp = kp.at[phys, offset].set(k[:, 0].reshape(b, fused).astype(kp.dtype))
-        vp = vp.at[phys, offset].set(v[:, 0].reshape(b, fused).astype(vp.dtype))
+        kp = kp.at[phys, offset].set(
+            k[:, 0].reshape(b, fused).astype(kp.dtype), mode="drop")
+        vp = vp.at[phys, offset].set(
+            v[:, 0].reshape(b, fused).astype(vp.dtype), mode="drop")
         attn = paged_attention(
             q[:, 0], kp, vp, page_table, lengths + 1,
             n_kv_heads=spec.n_kv_heads, impl=attn_impl,

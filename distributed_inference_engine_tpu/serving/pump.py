@@ -1,0 +1,179 @@
+"""EnginePump: async facade over the continuous engine's synchronous pump.
+
+The missing piece between the asyncio serving plane and the slot-based
+engine: ``ContinuousEngine`` is single-threaded synchronous (XLA dispatch),
+while the worker serves many concurrent RPC connections. The pump owns a
+dedicated engine thread; RPC handlers ``await generate(...)`` and their
+requests are admitted into the SAME rolling decode batch — concurrent
+connections share chunks instead of serializing whole generations behind the
+executor (which is what the static ``Engine`` path does).
+
+This is continuous batching made visible at the serving layer: the
+reference's batcher coalesced requests *before* dispatch
+(``src/batcher.py:140-166``); here coalescing happens *inside* the engine
+continuously, so a request arriving mid-flight starts its prefill at the
+next chunk boundary instead of waiting for the previous batch to finish.
+
+Thread discipline: every engine method runs on the pump thread only. The
+asyncio side talks through a thread-safe inbox + ``call_soon_threadsafe``
+future resolution — the same single-writer rule the reference kept with its
+one-loop asyncio design (SURVEY.md §5 race-detection row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine.types import GenerationRequest, GenerationResult
+
+logger = logging.getLogger(__name__)
+
+
+class EnginePump:
+    """Drives a ``ContinuousEngine`` on a dedicated thread; asyncio-facing
+    ``generate`` joins requests into the rolling batch."""
+
+    def __init__(self, engine: Any, idle_wait_s: float = 0.25,
+                 error_backoff_s: float = 0.05) -> None:
+        self.engine = engine
+        self.idle_wait_s = idle_wait_s          # safety-net poll when idle
+        self.error_backoff_s = error_backoff_s  # pause after a failed step
+        self._inbox: List[Tuple[GenerationRequest, asyncio.Future,
+                                asyncio.AbstractEventLoop]] = []
+        self._inbox_lock = threading.Lock()
+        # pump id -> (future, loop, caller's original request id)
+        self._futures: Dict[str, Tuple[asyncio.Future,
+                                       asyncio.AbstractEventLoop, str]] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._step_errors = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ asyncio
+
+    async def generate(self, requests: List[GenerationRequest]
+                       ) -> List[GenerationResult]:
+        """Submit into the rolling batch; resolves when all finish."""
+        self._ensure_thread()
+        loop = asyncio.get_running_loop()
+        futs: List[asyncio.Future] = []
+        with self._inbox_lock:
+            for r in requests:
+                fut: asyncio.Future = loop.create_future()
+                self._inbox.append((r, fut, loop))
+                futs.append(fut)
+        self._wake.set()
+        results = await asyncio.gather(*futs)
+        return list(results)
+
+    async def stop(self) -> None:
+        self.shutdown_nowait()
+        t = self._thread
+        if t is not None:
+            await asyncio.get_running_loop().run_in_executor(None, t.join, 5.0)
+
+    def shutdown_nowait(self) -> None:
+        """Synchronous shutdown signal (usable from non-async callers, e.g.
+        ``WorkerServer.stop``): stops the thread and fails every in-flight
+        and queued future so no RPC client awaits forever."""
+        self._stop.set()
+        self._wake.set()
+        exc = RuntimeError("engine pump shut down")
+        with self._inbox_lock:
+            pending, self._inbox = self._inbox, []
+        for _req, fut, loop in pending:
+            loop.call_soon_threadsafe(self._set_exc, fut, exc)
+        self._fail_all(exc)
+
+    # ------------------------------------------------------------- thread
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="engine-pump", daemon=True
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        logger.info("engine pump started")
+        while not self._stop.is_set():
+            admitted = self._drain_inbox()
+            live = 0
+            try:
+                if admitted or self.engine.n_live or self.engine.n_waiting:
+                    live = self.engine.step()
+                    for res in self.engine.drain_finished():
+                        self._resolve(res)
+            except Exception as e:  # engine failure fans to all in-flight
+                self._step_errors += 1
+                logger.exception("engine pump step failed")
+                self._fail_all(e)
+                # drop the broken batch so n_live can't spin the loop hot,
+                # then back off before serving fresh submissions
+                try:
+                    self.engine.abort_all()
+                except Exception:
+                    logger.exception("engine abort_all failed")
+                time.sleep(self.error_backoff_s)
+                continue
+            if not live and not self.engine.n_waiting:
+                # idle: block until new work arrives
+                self._wake.wait(timeout=self.idle_wait_s)
+                self._wake.clear()
+        # fail anything still in flight so no caller hangs on shutdown
+        self._fail_all(RuntimeError("engine pump shut down"))
+        logger.info("engine pump stopped")
+
+    def _drain_inbox(self) -> int:
+        with self._inbox_lock:
+            batch, self._inbox = self._inbox, []
+        for req, fut, loop in batch:
+            pump_id = f"pump-{id(self):x}-{len(self._futures)}-{time.monotonic_ns()}"
+            original_id = req.request_id
+            req.request_id = pump_id
+            self._futures[pump_id] = (fut, loop, original_id)
+            try:
+                self.engine.submit(req)
+            except Exception as e:
+                del self._futures[pump_id]
+                loop.call_soon_threadsafe(self._set_exc, fut, e)
+        return len(batch)
+
+    def _resolve(self, res: GenerationResult) -> None:
+        entry = self._futures.pop(res.request_id, None)
+        if entry is None:
+            logger.warning("pump: no future for %s", res.request_id)
+            return
+        fut, loop, original_id = entry
+        res.request_id = original_id or res.request_id
+        loop.call_soon_threadsafe(self._set_result, fut, res)
+
+    def _fail_all(self, exc: Exception) -> None:
+        futures, self._futures = self._futures, {}
+        for fut, loop, _orig in futures.values():
+            loop.call_soon_threadsafe(self._set_exc, fut, exc)
+
+    @staticmethod
+    def _set_result(fut: asyncio.Future, value: Any) -> None:
+        if not fut.done():
+            fut.set_result(value)
+
+    @staticmethod
+    def _set_exc(fut: asyncio.Future, exc: Exception) -> None:
+        if not fut.done():
+            fut.set_exception(exc)
+
+    # ------------------------------------------------------------- stats
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "in_flight": len(self._futures),
+            "thread_alive": bool(self._thread and self._thread.is_alive()),
+            "step_errors": self._step_errors,
+            "engine": self.engine.get_metrics(),
+        }

@@ -1,0 +1,127 @@
+"""Continuous-batching engine: greedy parity with the static engine,
+mid-flight admission, page-pool pressure, and capacity finishes."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_engine_tpu.config import EngineConfig
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.engine import Engine
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.base import ModelSpec
+
+SPEC = ModelSpec(
+    vocab_size=512, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=256, max_seq_len=256, dtype="float32",
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        max_slots=4, max_seq_len=128, prefill_buckets=[16, 64],
+        page_size=16, num_pages=32, decode_steps_per_call=4,
+        attention_impl="xla", kv_dtype="float32",
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _reqs(rs, n, prompt_len=10, max_new=12):
+    return [
+        GenerationRequest(
+            prompt=rs.randint(1, SPEC.vocab_size, size=prompt_len).tolist(),
+            max_new_tokens=max_new, temperature=0.0, request_id=f"r{i}",
+        )
+        for i in range(n)
+    ]
+
+
+def test_greedy_parity_with_static_engine():
+    """Same params, same greedy prompts -> identical tokens from the
+    continuous (paged) and static (contiguous) engines."""
+    rs = np.random.RandomState(0)
+    reqs = _reqs(rs, 3)
+    static = Engine(SPEC, config=_cfg(), seed=0)
+    cont = ContinuousEngine(SPEC, params=static.params, config=_cfg(), seed=0)
+    out_s = static.generate([GenerationRequest(**{
+        "prompt": r.prompt, "max_new_tokens": r.max_new_tokens,
+        "temperature": 0.0, "request_id": r.request_id}) for r in reqs])
+    out_c = cont.generate(reqs)
+    for a, b in zip(out_s, out_c):
+        assert a.request_id == b.request_id
+        assert a.tokens == b.tokens, (a.tokens, b.tokens)
+        assert b.finish_reason == "length"
+
+
+def test_mid_flight_admission():
+    """Requests submitted while others decode join without disturbing them."""
+    rs = np.random.RandomState(1)
+    cont = ContinuousEngine(SPEC, config=_cfg(max_slots=2), seed=0)
+    first = _reqs(rs, 2, max_new=20)
+    for r in first:
+        cont.submit(r)
+    cont.step()                      # both admitted + one chunk
+    assert cont.n_live == 2
+    late = GenerationRequest(prompt=[7, 8, 9], max_new_tokens=4,
+                             temperature=0.0, request_id="late")
+    cont.submit(late)
+    assert cont.n_waiting == 1       # no free slot yet
+    results = cont.run_until_idle()
+    ids = {r.request_id for r in results}
+    assert ids == {"r0", "r1", "late"}
+    late_res = next(r for r in results if r.request_id == "late")
+    assert len(late_res.tokens) == 4
+
+
+def test_eos_stops_early_and_frees_slot():
+    rs = np.random.RandomState(2)
+    cont = ContinuousEngine(SPEC, config=_cfg(), seed=0)
+    # run one greedy request to learn its 3rd token, then use it as eos
+    probe = cont.generate(_reqs(rs, 1, max_new=8))[0]
+    eos = probe.tokens[2]
+    rs = np.random.RandomState(2)    # same prompt again
+    req = _reqs(rs, 1, max_new=8)[0]
+    req.eos_id = eos
+    res = cont.generate([req])[0]
+    assert res.finish_reason == "stop"
+    assert res.tokens == probe.tokens[:3]
+    assert cont.kv.get_stats()["live_slots"] == 0
+
+
+def test_page_pool_pressure_shortens_but_completes():
+    """A pool far too small for all requests at once still completes all of
+    them (admission control queues, capacity finishes bound sequences)."""
+    rs = np.random.RandomState(3)
+    cfg = _cfg(max_slots=4, num_pages=6, page_size=16, max_seq_len=96)
+    cont = ContinuousEngine(SPEC, config=cfg, seed=0)
+    reqs = _reqs(rs, 6, prompt_len=20, max_new=30)
+    results = cont.generate(reqs)
+    assert len(results) == 6
+    assert {r.request_id for r in results} == {f"r{i}" for i in range(6)}
+    for r in results:
+        assert len(r.tokens) >= 1
+    stats = cont.get_metrics()
+    assert stats["kv"]["pages_used"] == 0            # everything freed
+    assert stats["admission_denied"] > 0             # pool actually pressured
+
+
+def test_max_seq_len_capacity_finish():
+    """A request that would decode past max_seq_len is finished with
+    reason 'length' instead of corrupting pages (review finding)."""
+    cfg = _cfg(max_slots=1, num_pages=32, page_size=16, max_seq_len=32)
+    cont = ContinuousEngine(SPEC, config=cfg, seed=0)
+    req = GenerationRequest(prompt=list(range(1, 29)), max_new_tokens=50,
+                            temperature=0.0, request_id="long")
+    res = cont.generate([req])[0]
+    assert res.finish_reason == "length"
+    # 28 prompt + n generated <= 32 total positions -> at most 4 generated
+    assert 1 <= len(res.tokens) <= 5
+    assert cont.get_metrics()["kv"]["pages_used"] == 0
+
+
+def test_metrics_shape():
+    cont = ContinuousEngine(SPEC, config=_cfg(), seed=0)
+    m = cont.get_metrics()
+    for k in ("total_requests", "waiting", "live_slots", "kv",
+              "prefill", "decode_chunk", "attn_impl"):
+        assert k in m, k
