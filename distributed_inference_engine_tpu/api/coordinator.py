@@ -155,7 +155,13 @@ class Coordinator:
             if wid in hosted:
                 continue
             client = self.router.client_for(wid)
-            await client.load_model(cfg, timeout=load_timeout_s)
+            try:
+                await client.load_model(cfg, timeout=load_timeout_s)
+            except WorkerRPCError as e:
+                # a worker that preloaded the model at startup (CLI --model)
+                # is a valid deploy target, not a failure
+                if "already loaded" not in str(e):
+                    raise
             self.registry.add_shard(cfg.name, cfg.version, shard_id=next_id,
                                     worker_id=wid, status=ModelStatus.READY)
             next_id += 1
@@ -336,7 +342,12 @@ class Coordinator:
         self.lb.update_stats(worker_id, success=True,
                              latency_s=time.perf_counter() - t0)
         self.router.mark_worker_success(worker_id)
-        return [result_to_dict(r) for r in results]
+        out = []
+        for r in results:
+            d = result_to_dict(r)
+            d["metadata"]["worker_id"] = worker_id   # end-to-end trace: who served
+            out.append(d)
+        return out
 
     # -- introspection ------------------------------------------------------
 

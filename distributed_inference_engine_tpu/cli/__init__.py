@@ -1,0 +1,7 @@
+"""CLI entry points — heirs of the reference's ``examples/*`` run
+instructions (``README.md:104-110``) and ``worker.main()``
+(``src/worker.py:211-250``), as installable modules:
+
+    python -m distributed_inference_engine_tpu.cli.worker
+    python -m distributed_inference_engine_tpu.cli.coordinator
+"""

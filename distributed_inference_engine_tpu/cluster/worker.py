@@ -214,6 +214,14 @@ class WorkerServer(FramedServerMixin):
                     self.worker_id, cfg.name, cfg.architecture,
                     time.perf_counter() - t0)
 
+    async def load_model_async(self, cfg: ModelConfig) -> None:
+        """Load off the event loop, on the single engine thread — serializes
+        with in-flight generates (one program on the chip at a time) and two
+        concurrent loads of the same name can't race the already-loaded
+        check. Used by both the RPC handler and the CLI."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(self._executor, self.load_model, cfg)
+
     def unload_model(self, name: str) -> bool:
         engine = self.engines.pop(name, None)
         self.model_configs.pop(name, None)
@@ -307,12 +315,7 @@ class WorkerServer(FramedServerMixin):
 
     async def _rpc_load_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         cfg = ModelConfig.from_dict(msg["config"])
-        loop = asyncio.get_running_loop()
-        # engine construction can jit-compile — keep it off the event loop,
-        # and on the single engine thread so it serializes with in-flight
-        # generates (one program on the chip at a time) and two concurrent
-        # loads of the same name can't race the already-loaded check
-        await loop.run_in_executor(self._executor, self.load_model, cfg)
+        await self.load_model_async(cfg)
         return {"loaded": cfg.name}
 
     async def _rpc_unload_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,24 @@
+"""Platform pinning helper.
+
+This environment's ``sitecustomize`` registers an experimental TPU-tunnel
+plugin at interpreter startup and force-updates ``jax_platforms``, clobbering
+the ``JAX_PLATFORMS`` env var — a process asking for CPU can still dial the
+(possibly unreachable) tunnel and hang at first backend init. Every non-test
+entry point (demos, CLIs) calls ``pin_platform_from_env()`` before touching
+jax; ``tests/conftest.py`` and ``__graft_entry__.py`` carry their own copies
+because they must run before this package imports.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """If JAX_PLATFORMS requests cpu, re-pin jax's config to cpu before any
+    backend initialization. No-op otherwise."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in want.split(","):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

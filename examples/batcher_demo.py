@@ -1,0 +1,86 @@
+"""Scripted batcher demo — heir of the reference's
+``examples/batcher_demo.py``: shows size-triggered flushes, latency-triggered
+flushes, and error fan-out, with a fake backend (no device needed).
+
+    python examples/batcher_demo.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_inference_engine_tpu.serving.batcher import (  # noqa: E402
+    Batcher,
+)
+
+BATCHES = []
+
+
+async def fake_backend(model, version, inputs):
+    """Batch-shaped backend (reference ``mock_inference.py:12-73``): echoes
+    per-input results after a fixed latency; PAD_INPUT entries (bucket
+    padding) get None slots that the batcher drops."""
+    reals = [i for i in inputs
+             if not (isinstance(i, dict) and i.get("__pad__"))]
+    BATCHES.append(len(reals))
+    await asyncio.sleep(0.05)
+    return [{"echo": i} for i in reals]
+
+
+async def size_trigger_demo():
+    print("=== size trigger: 12 requests, max_batch=5 -> batches of 5,5,2 ===")
+    b = Batcher(batch_callback=fake_backend, max_batch_size=5,
+                max_latency_ms=500)
+    await b.start()
+    futs = [await b.add_request("m", "1", {"i": i}) for i in range(12)]
+    results = await asyncio.gather(*futs)
+    await b.stop()
+    print(f"  batch sizes: {BATCHES}")
+    print(f"  results ok: {all(r['echo']['i'] == i for i, r in enumerate(results))}")
+    print(f"  stats: {b.get_stats()}")
+
+
+async def latency_trigger_demo():
+    BATCHES.clear()
+    print("=== latency trigger: 2 requests, max_batch=8, 100ms window ===")
+    b = Batcher(batch_callback=fake_backend, max_batch_size=8,
+                max_latency_ms=100)
+    await b.start()
+    import time
+    t0 = time.perf_counter()
+    futs = [await b.add_request("m", "1", {"i": i}) for i in range(2)]
+    await asyncio.gather(*futs)
+    wall = (time.perf_counter() - t0) * 1e3
+    await b.stop()
+    print(f"  flushed after {wall:.0f}ms (window 100ms), batch sizes {BATCHES}")
+
+
+async def error_fanout_demo():
+    print("=== error fan-out: backend failure reaches every future ===")
+
+    async def broken(model, version, inputs):
+        raise RuntimeError("backend exploded")
+
+    b = Batcher(batch_callback=broken, max_batch_size=2, max_latency_ms=50)
+    await b.start()
+    futs = [await b.add_request("m", "1", {"i": i}) for i in range(2)]
+    errs = 0
+    for f in futs:
+        try:
+            await f
+        except RuntimeError:
+            errs += 1
+    await b.stop()
+    print(f"  {errs}/2 futures received the backend error")
+
+
+async def main():
+    await size_trigger_demo()
+    await latency_trigger_demo()
+    await error_fanout_demo()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

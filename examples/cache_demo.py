@@ -1,0 +1,82 @@
+"""Interactive cache CLI — heir of the reference's
+``examples/kvstore_demo.py`` (get/set/delete/stats REPL over the cache).
+
+    set <key> <value> [ttl_s]
+    get <key>
+    del <key>
+    stats | clear | keys | quit
+
+Non-interactive: --script "set a 1; get a; stats"
+Policy via --policy {lru,lfu,fifo}, capacity via --max-size.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_inference_engine_tpu.serving.cache import ResponseCache  # noqa: E402
+
+
+def handle(cache: ResponseCache, line: str) -> bool:
+    parts = line.split()
+    if not parts:
+        return True
+    cmd, args = parts[0], parts[1:]
+    try:
+        if cmd in ("quit", "exit"):
+            return False
+        elif cmd == "set":
+            ttl = float(args[2]) if len(args) > 2 else None
+            cache.set(args[0], args[1], ttl=ttl)
+            print(f"OK ({len(cache)} entries)")
+        elif cmd == "get":
+            t0 = time.perf_counter()
+            val = cache.get(args[0])
+            us = (time.perf_counter() - t0) * 1e6
+            print(f"{val!r} ({us:.0f}us)" if val is not None else "(miss)")
+        elif cmd == "del":
+            print("deleted" if cache.delete(args[0]) else "(no such key)")
+        elif cmd == "keys":
+            print(cache.keys())
+        elif cmd == "clear":
+            print(f"cleared {cache.clear()} entries")
+        elif cmd == "stats":
+            print(json.dumps(cache.get_stats(), indent=2))
+        else:
+            print(f"unknown command {cmd!r} (set/get/del/keys/clear/stats/quit)")
+    except Exception as e:
+        print(f"error: {type(e).__name__}: {e}")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--script", default="", help="semicolon-separated commands")
+    ap.add_argument("--policy", default="lru", choices=["lru", "lfu", "fifo"])
+    ap.add_argument("--max-size", type=int, default=1024)
+    ap.add_argument("--default-ttl", type=float, default=0.0,
+                    help="0 = no expiry")
+    args = ap.parse_args()
+    with ResponseCache(max_size=args.max_size, policy=args.policy,
+                       default_ttl=args.default_ttl or None) as cache:
+        print(f"cache: policy={args.policy} max_size={args.max_size}")
+        if args.script:
+            for line in args.script.split(";"):
+                print(f"> {line.strip()}")
+                if not handle(cache, line.strip()):
+                    break
+        else:
+            try:
+                while True:
+                    if not handle(cache, input("cache> ")):
+                        break
+            except (EOFError, KeyboardInterrupt):
+                pass
+
+
+if __name__ == "__main__":
+    main()

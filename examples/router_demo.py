@@ -1,0 +1,95 @@
+"""Router demo — heir of the reference's ``examples/router_demo.py``:
+shard-affinity routing, health marking, deterministic failover.
+
+    route <key>               which shard/worker serves this key
+    kill <worker_id>          mark a worker unhealthy (simulated failures)
+    revive <worker_id>
+    stats | quit
+
+Non-interactive: --script "route user-1; kill w0; route user-1; stats"
+No sockets and no engine — this exercises pure control-plane metadata math
+(reference ``src/router.py``; SURVEY.md §3.3).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_inference_engine_tpu.cluster.registry import (  # noqa: E402
+    ModelRegistry, ModelStatus,
+)
+from distributed_inference_engine_tpu.cluster.router import Router  # noqa: E402
+from distributed_inference_engine_tpu.config import (  # noqa: E402
+    HealthConfig, ModelConfig,
+)
+
+
+def build(n_workers: int, n_shards: int):
+    reg = ModelRegistry()
+    reg.register_model(ModelConfig(name="demo", version="1.0",
+                                   architecture="llama"))
+    router = Router(reg, health=HealthConfig(max_consecutive_failures=2))
+    for i in range(n_workers):
+        router.register_worker(f"w{i}", "10.0.0.%d" % i, 9000)
+    for s in range(n_shards):
+        reg.add_shard("demo", "1.0", worker_id=f"w{s % n_workers}",
+                      shard_id=s, status=ModelStatus.READY)
+    return reg, router
+
+
+def handle(router: Router, line: str) -> bool:
+    parts = line.split()
+    if not parts:
+        return True
+    cmd, args = parts[0], parts[1:]
+    try:
+        if cmd in ("quit", "exit"):
+            return False
+        elif cmd == "route":
+            r = router.route_request("demo", "1.0", args[0])
+            print(f"  key={args[0]!r} -> shard {r.shard.shard_id} on "
+                  f"{r.worker.worker_id} ({r.worker.address}) "
+                  f"failover={r.failover}")
+        elif cmd == "kill":
+            for _ in range(2):   # threshold in build() is 2
+                router.mark_worker_failure(args[0])
+            print(f"  {args[0]} marked unhealthy")
+        elif cmd == "revive":
+            router.mark_worker_success(args[0])
+            print(f"  {args[0]} healthy again")
+        elif cmd == "stats":
+            print(json.dumps(router.get_stats(), indent=2, default=str))
+        else:
+            print(f"unknown command {cmd!r} (route/kill/revive/stats/quit)")
+    except Exception as e:
+        print(f"error: {type(e).__name__}: {e}")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--script", default="")
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--shards", type=int, default=6)
+    args = ap.parse_args()
+    _, router = build(args.workers, args.shards)
+    print(f"router demo: {args.workers} workers, {args.shards} shards")
+    if args.script:
+        for line in args.script.split(";"):
+            print(f"> {line.strip()}")
+            if not handle(router, line.strip()):
+                break
+    else:
+        try:
+            while True:
+                if not handle(router, input("router> ")):
+                    break
+        except (EOFError, KeyboardInterrupt):
+            pass
+
+
+if __name__ == "__main__":
+    main()
