@@ -95,6 +95,8 @@ class Coordinator:
         self._running = False
         self._cache_hits = 0
         self._submitted = 0
+        self._model_configs: Dict[str, ModelConfig] = {}
+        self._tokenizers: Dict[str, Any] = {}   # model -> tokenizer (preproc)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -145,6 +147,7 @@ class Coordinator:
             raise RoutingError("no workers to deploy to")
         if self.registry.get_model_version(cfg.name, cfg.version) is None:
             self.registry.register_model(cfg)
+        self._model_configs[cfg.name] = cfg
         # idempotent scale-out: skip workers already hosting a shard, number
         # new shards after the existing ones
         existing = self.registry.all_shards(cfg.name, cfg.version)
@@ -155,13 +158,9 @@ class Coordinator:
             if wid in hosted:
                 continue
             client = self.router.client_for(wid)
-            try:
-                await client.load_model(cfg, timeout=load_timeout_s)
-            except WorkerRPCError as e:
-                # a worker that preloaded the model at startup (CLI --model)
-                # is a valid deploy target, not a failure
-                if "already loaded" not in str(e):
-                    raise
+            # worker-side load is idempotent for an identical config and
+            # errors on a mismatched one — no error-text sniffing needed
+            await client.load_model(cfg, timeout=load_timeout_s)
             self.registry.add_shard(cfg.name, cfg.version, shard_id=next_id,
                                     worker_id=wid, status=ModelStatus.READY)
             next_id += 1
@@ -173,7 +172,7 @@ class Coordinator:
     async def submit(
         self,
         model: str,
-        prompt: Sequence[int],
+        prompt: Optional[Sequence[int]] = None,
         version: str = "1.0",
         max_new_tokens: int = 16,
         temperature: float = 0.0,
@@ -183,11 +182,26 @@ class Coordinator:
         key: Optional[str] = None,
         request_id: Optional[str] = None,
         no_cache: bool = False,
+        text: Optional[str] = None,
     ) -> Dict[str, Any]:
         """One generation request, end to end. Returns a result dict
-        (``result_to_dict`` schema) plus trace/cache metadata."""
+        (``result_to_dict`` schema) plus trace/cache metadata.
+
+        ``text`` is the preproc/postproc path the reference README declares
+        (``README.md:96-98``): the coordinator tokenizes it host-side
+        (``utils/tokenizer.py``) and the result carries a detokenized
+        ``"text"`` field alongside the raw tokens.
+        """
         if not self._running:
             raise RuntimeError("coordinator is not running")
+        tokenizer = None
+        if text is not None:
+            if prompt is not None:
+                raise ValueError("pass prompt or text, not both")
+            tokenizer = self._tokenizer_for(model)
+            prompt = tokenizer.encode(text)
+        if not prompt:
+            raise ValueError("empty prompt")
         self._submitted += 1
         request_id = request_id or new_request_id()
         affinity = key if key is not None else request_id
@@ -210,6 +224,11 @@ class Coordinator:
                 out["request_id"] = request_id
                 out["cached"] = True
                 out["trace"] = trace.to_dict()
+                if tokenizer is not None:
+                    # entries are cached in token space only; text is derived
+                    # per-request so token- and text-mode callers can share
+                    # one entry and each get a consistent schema
+                    out["text"] = tokenizer.decode(out.get("tokens", []))
                 return out
 
         inputs = {
@@ -230,11 +249,36 @@ class Coordinator:
         result = dict(result)
         result["cached"] = False
         result["trace"] = trace.to_dict()
+        if tokenizer is not None:
+            result["text"] = tokenizer.decode(result.get("tokens", []))
         if cacheable and cache_key is not None:
             stripped = {k: v for k, v in result.items()
-                        if k not in ("trace", "cached")}
+                        if k not in ("trace", "cached", "text")}
             self.cache.set(cache_key, stripped)
         return result
+
+    def _tokenizer_for(self, model: str):
+        """Per-model tokenizer keyed by (name, path) so a redeploy with a new
+        checkpoint path picks up fresh vocab files."""
+        cfg = self._model_configs.get(model)
+        path = cfg.path if cfg else ""
+        key = (model, path)
+        tok = self._tokenizers.get(key)
+        if tok is None:
+            from ..utils.tokenizer import ByteTokenizer, build_tokenizer
+
+            tok = build_tokenizer(path)
+            if (isinstance(tok, ByteTokenizer) and cfg is not None
+                    and cfg.architecture != "fake"
+                    and cfg.metadata.get("tokenizer") != "byte"):
+                logger.warning(
+                    "model %s has no vocab.json/merges.txt under %r — text "
+                    "requests use the byte-level tokenizer, whose ids do NOT "
+                    "match a trained BPE vocab (set metadata.tokenizer='byte' "
+                    "to silence)", model, path,
+                )
+            self._tokenizers[key] = tok
+        return tok
 
     # -- batch dispatch (the batcher's backend) -----------------------------
 

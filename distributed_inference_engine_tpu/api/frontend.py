@@ -81,7 +81,8 @@ class CoordinatorServer(FramedServerMixin):
     async def _rpc_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return await self.coordinator.submit(
             model=msg["model"],
-            prompt=msg["prompt"],
+            prompt=msg.get("prompt"),
+            text=msg.get("text"),
             version=msg.get("version", "1.0"),
             max_new_tokens=int(msg.get("max_new_tokens", 16)),
             temperature=float(msg.get("temperature", 0.0)),
@@ -125,10 +126,13 @@ class CoordinatorClient(FramedRPCClient):
     def __init__(self, host: str, port: int, timeout: float = 120.0) -> None:
         super().__init__(host, port, timeout=timeout)
 
-    async def generate(self, model: str, prompt: List[int],
+    async def generate(self, model: str, prompt: Optional[List[int]] = None,
                        **kwargs: Any) -> Dict[str, Any]:
-        return await self.call("generate", model=model, prompt=list(prompt),
-                               **kwargs)
+        """Token-space (``prompt=[ids]``) or text-space (``text="..."``,
+        coordinator tokenizes and the result carries ``"text"``)."""
+        return await self.call(
+            "generate", model=model,
+            prompt=list(prompt) if prompt is not None else None, **kwargs)
 
     async def deploy_model(self, cfg: ModelConfig,
                            workers: Optional[List[str]] = None,

@@ -68,8 +68,10 @@ async def amain(args: argparse.Namespace) -> None:
 
     coord = Coordinator(ccfg)
     server = CoordinatorServer(coord, server_cfg)
-    host, port = await server.start()
-    print(f"coordinator listening on {host}:{port}", flush=True)
+    # register + deploy BEFORE announcing the address — the "listening" line
+    # is the readiness signal (same convention as cli/worker.py), so a script
+    # that waits on it can generate immediately
+    await coord.start()
     for spec in args.worker:
         wid, whost, wport = parse_worker_arg(spec)
         coord.add_worker(wid, whost, wport)
@@ -77,6 +79,8 @@ async def amain(args: argparse.Namespace) -> None:
     for m in deploys:
         n = await coord.deploy_model(m)
         print(f"deployed {m.name} across {n} workers", flush=True)
+    host, port = await server.start()
+    print(f"coordinator listening on {host}:{port}", flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -200,7 +200,16 @@ class WorkerServer(FramedServerMixin):
 
     def load_model(self, cfg: ModelConfig) -> None:
         if cfg.name in self.engines:
-            raise ValueError(f"model {cfg.name!r} already loaded")
+            # idempotent for an identical config (a worker preloaded via CLI
+            # is a valid deploy target); a DIFFERENT config is a real error —
+            # silently serving mismatched engines corrupts placement
+            if self.model_configs[cfg.name].to_dict() == cfg.to_dict():
+                logger.info("worker %s: model %s already loaded (idempotent)",
+                            self.worker_id, cfg.name)
+                return
+            raise ValueError(
+                f"model {cfg.name!r} already loaded with a different config"
+            )
         t0 = time.perf_counter()
         engine = self.engine_factory(cfg)
         self.engines[cfg.name] = engine

@@ -1,0 +1,49 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+``load_library(name)`` compiles ``native/<name>.cpp`` into a cached shared
+object (rebuilt when the source is newer) and returns the ctypes handle, or
+``None`` when no C++ toolchain is available — callers must keep a pure-Python
+fallback so the framework degrades gracefully (SURVEY.md §2.2: the reference
+mandates no native component; ours accelerate host-side hot paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = pathlib.Path(__file__).parent
+_CACHE: dict = {}
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    if name in _CACHE:
+        return _CACHE[name]
+    src = _DIR / f"{name}.cpp"
+    so = _DIR / f"_{name}.so"
+    lib: Optional[ctypes.CDLL] = None
+    try:
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            # build into a temp file then rename: concurrent importers must
+            # never dlopen a half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_DIR))
+            os.close(fd)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   str(src), "-o", tmp]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+            logger.info("built native library %s", so.name)
+        lib = ctypes.CDLL(str(so))
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native %s unavailable (%s) — using Python fallback",
+                       name, e)
+        lib = None
+    _CACHE[name] = lib
+    return lib

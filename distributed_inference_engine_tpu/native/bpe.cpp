@@ -1,0 +1,125 @@
+// Byte-pair-encoding merge core, C ABI for ctypes.
+//
+// The host-side preprocessing layer the reference README declares
+// (preproc.py/postproc.py, README.md:96-98) but never ships. Tokenization is
+// pure host work on the serving critical path (it bounds TTFT alongside
+// prefill), so the merge loop is native: the Python wrapper
+// (utils/tokenizer.py) handles vocab I/O and byte<->unicode mapping and
+// calls into this for the O(n log n) merge algorithm.
+//
+// Algorithm: classic ranked BPE. Tokens start as byte ids; the merge table
+// maps (left,right) -> (rank, new_id); repeatedly merge the lowest-ranked
+// adjacent pair until none applies. Linked list + min-heap: each merge is
+// O(log n), total O(n log n) per sequence.
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return (static_cast<size_t>(static_cast<uint32_t>(p.first)) << 32) ^
+           static_cast<uint32_t>(p.second);
+  }
+};
+
+struct MergeTable {
+  std::unordered_map<std::pair<int32_t, int32_t>, std::pair<int32_t, int32_t>,
+                     PairHash>
+      ranks;  // (l,r) -> (rank, new_id)
+};
+
+struct HeapItem {
+  int32_t rank;
+  int32_t pos;   // index of left element in the working array
+  uint64_t stamp;  // versioning: stale entries are skipped
+  bool operator>(const HeapItem& o) const {
+    if (rank != o.rank) return rank > o.rank;
+    return pos > o.pos;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// merges: flat int32 triples [left, right, new_id] in rank order.
+void* bpe_new(const int32_t* merges, int32_t n_merges) {
+  auto* t = new MergeTable();
+  t->ranks.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int32_t i = 0; i < n_merges; ++i) {
+    const int32_t l = merges[3 * i], r = merges[3 * i + 1],
+                  nid = merges[3 * i + 2];
+    t->ranks.emplace(std::make_pair(l, r), std::make_pair(i, nid));
+  }
+  return t;
+}
+
+void bpe_free(void* handle) { delete static_cast<MergeTable*>(handle); }
+
+// Encode in place: ids/n are the byte-level input; out receives merged ids.
+// Returns the output length (<= n). out must have capacity n.
+int32_t bpe_encode(void* handle, const int32_t* ids, int32_t n, int32_t* out) {
+  if (n <= 0) return 0;
+  auto* t = static_cast<MergeTable*>(handle);
+
+  // doubly linked list over a working array
+  std::vector<int32_t> tok(ids, ids + n);
+  std::vector<int32_t> prev(n), next(n);
+  std::vector<uint64_t> stamp(n, 0);
+  for (int32_t i = 0; i < n; ++i) {
+    prev[i] = i - 1;
+    next[i] = (i + 1 < n) ? i + 1 : -1;
+  }
+
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>>
+      heap;
+  auto push_pair = [&](int32_t pos) {
+    const int32_t nx = next[pos];
+    if (nx < 0) return;
+    auto it = t->ranks.find({tok[pos], tok[nx]});
+    if (it != t->ranks.end())
+      heap.push({it->second.first, pos, stamp[pos]});
+  };
+  for (int32_t i = 0; i < n; ++i) push_pair(i);
+
+  int32_t alive = n;
+  std::vector<bool> dead(n, false);
+  while (!heap.empty()) {
+    const HeapItem item = heap.top();
+    heap.pop();
+    const int32_t pos = item.pos;
+    if (dead[pos] || item.stamp != stamp[pos]) continue;
+    const int32_t nx = next[pos];
+    if (nx < 0) continue;
+    auto it = t->ranks.find({tok[pos], tok[nx]});
+    if (it == t->ranks.end() || it->second.first != item.rank) continue;
+
+    // merge nx into pos
+    tok[pos] = it->second.second;
+    ++stamp[pos];
+    dead[nx] = true;
+    --alive;
+    const int32_t nn = next[nx];
+    next[pos] = nn;
+    if (nn >= 0) prev[nn] = pos;
+    // re-examine the pairs (prev,pos) and (pos,next)
+    const int32_t pv = prev[pos];
+    if (pv >= 0) {
+      ++stamp[pv];
+      push_pair(pv);
+    }
+    push_pair(pos);
+  }
+
+  int32_t m = 0;
+  for (int32_t i = 0; i >= 0 && i < n; i = next[i])
+    out[m++] = tok[i];
+  return m;
+}
+
+}  // extern "C"

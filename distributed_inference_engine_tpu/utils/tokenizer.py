@@ -1,0 +1,216 @@
+"""Tokenizers: the preproc/postproc layer the reference README declares
+(``README.md:96-98`` — "tokenization, padding" / "decoding outputs") but
+never implements (its engine echoes opaque blobs).
+
+Two tokenizers, one encode core:
+
+- ``ByteTokenizer`` — zero-dependency byte-level fallback: UTF-8 bytes are
+  the ids (vocab 256 + specials). Always available; what the demos use.
+- ``BPETokenizer`` — GPT-2-style byte-level BPE from local ``vocab.json`` +
+  ``merges.txt`` (HF checkpoint format; zero-egress: nothing is downloaded).
+  The ranked-merge loop is native C++ (``native/bpe.cpp``, O(n log n) linked
+  list + heap) with a pure-Python mirror used when no toolchain exists —
+  both run the classic algorithm, so outputs are identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import json
+import pathlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..native import load_library
+
+
+# ------------------------------------------------------------ byte-level
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids; specials appended after 255."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids.insert(0, self.BOS)
+        if add_eos:
+            ids.append(self.EOS)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+# ------------------------------------------------- GPT-2 byte<->unicode map
+
+
+@functools.lru_cache(maxsize=1)
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte->printable-unicode mapping (needed to read HF
+    vocab/merges files, which store tokens in this alphabet)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+# ------------------------------------------------------------ merge cores
+
+
+def _py_bpe_encode(ids: List[int],
+                   ranks: Dict[Tuple[int, int], Tuple[int, int]]) -> List[int]:
+    """Pure-Python mirror of native/bpe.cpp (same ranked-merge semantics)."""
+    ids = list(ids)
+    while len(ids) > 1:
+        best = None
+        best_rank = None
+        for i in range(len(ids) - 1):
+            r = ranks.get((ids[i], ids[i + 1]))
+            if r is not None and (best_rank is None or r[0] < best_rank):
+                best_rank, best = r[0], i
+        if best is None:
+            break
+        new_id = ranks[(ids[best], ids[best + 1])][1]
+        ids[best: best + 2] = [new_id]
+    return ids
+
+
+class _NativeBPE:
+    """ctypes wrapper over native/bpe.cpp."""
+
+    def __init__(self, merges: List[Tuple[int, int, int]]) -> None:
+        lib = load_library("bpe")
+        if lib is None:
+            raise OSError("no native toolchain")
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_new.argtypes = [ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode.restype = ctypes.c_int32
+        lib.bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        self._lib = lib
+        flat = (ctypes.c_int32 * (3 * len(merges)))()
+        for i, (l, r, nid) in enumerate(merges):
+            flat[3 * i], flat[3 * i + 1], flat[3 * i + 2] = l, r, nid
+        self._handle = lib.bpe_new(flat, len(merges))
+
+    def encode(self, ids: Sequence[int]) -> List[int]:
+        n = len(ids)
+        if n == 0:
+            return []
+        arr = (ctypes.c_int32 * n)(*ids)
+        out = (ctypes.c_int32 * n)()
+        m = self._lib.bpe_encode(self._handle, arr, n, out)
+        return list(out[:m])
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_handle", None):
+            lib.bpe_free(self._handle)
+            self._handle = None
+
+
+# ---------------------------------------------------------------- BPE
+
+
+class BPETokenizer:
+    """GPT-2-style byte-level BPE from a local HF checkpoint directory."""
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: List[Tuple[str, str]],
+                 use_native: bool = True) -> None:
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        b2u = _bytes_to_unicode()
+        self._byte_to_unit = {b: vocab[u] for b, u in b2u.items() if u in vocab}
+        self._u2b = {u: b for b, u in b2u.items()}
+        # merge table in id space: (left_id, right_id) -> (rank, merged_id)
+        triples: List[Tuple[int, int, int]] = []
+        self.ranks: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for rank, (a, b) in enumerate(merges):
+            ia, ib, iab = vocab.get(a), vocab.get(b), vocab.get(a + b)
+            if ia is None or ib is None or iab is None:
+                continue
+            triples.append((ia, ib, iab))
+            self.ranks[(ia, ib)] = (rank, iab)
+        self._native: Optional[_NativeBPE] = None
+        if use_native:
+            try:
+                self._native = _NativeBPE(triples)
+            except OSError:
+                self._native = None
+
+    @classmethod
+    def from_pretrained_dir(cls, path: str, **kw) -> "BPETokenizer":
+        p = pathlib.Path(path)
+        vocab = json.loads((p / "vocab.json").read_text())
+        merges = []
+        for line in (p / "merges.txt").read_text().splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            a, b = line.split()
+            merges.append((a, b))
+        return cls(vocab, merges, **kw)
+
+    # GPT-2's pre-tokenization pattern: merges only apply WITHIN these
+    # chunks (contractions / space-prefixed words / numbers / punctuation /
+    # whitespace). Skipping this split makes ids diverge from the HF
+    # tokenizer the vocab belongs to.
+    _PRETOK = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+               r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+    @functools.cached_property
+    def _pretok_re(self):
+        import regex
+
+        return regex.compile(self._PRETOK)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def native_enabled(self) -> bool:
+        return self._native is not None
+
+    def encode(self, text: str) -> List[int]:
+        out: List[int] = []
+        for chunk in self._pretok_re.findall(text):
+            ids = [self._byte_to_unit[b] for b in chunk.encode("utf-8")
+                   if b in self._byte_to_unit]
+            if self._native is not None:
+                out.extend(self._native.encode(ids))
+            else:
+                out.extend(_py_bpe_encode(ids, self.ranks))
+        return out
+
+    def decode(self, ids: Sequence[int]) -> str:
+        units = "".join(self.inv_vocab.get(i, "") for i in ids)
+        data = bytes(self._u2b[u] for u in units if u in self._u2b)
+        return data.decode("utf-8", errors="replace")
+
+
+def build_tokenizer(path: str = "") -> object:
+    """Checkpoint dir with vocab.json+merges.txt -> BPE; else byte-level."""
+    if path:
+        p = pathlib.Path(path)
+        if (p / "vocab.json").exists() and (p / "merges.txt").exists():
+            return BPETokenizer.from_pretrained_dir(path)
+    return ByteTokenizer()

@@ -1,0 +1,36 @@
+"""Shared demo REPL scaffolding: one command loop for every interactive demo
+(worker/cache/router) — sync or async handlers, semicolon-scripted or
+interactive with EOF/Ctrl-C handling."""
+
+import asyncio
+import inspect
+
+
+async def run_repl(handle, prompt: str, script: str = "") -> None:
+    """Drive ``handle(line) -> bool`` (False = quit; sync or async) from a
+    semicolon-separated script, or interactively from stdin."""
+
+    async def call(line: str) -> bool:
+        result = handle(line)
+        if inspect.isawaitable(result):
+            result = await result
+        return result
+
+    try:
+        if script:
+            for line in script.split(";"):
+                print(f"> {line.strip()}")
+                if not await call(line.strip()):
+                    break
+        else:
+            loop = asyncio.get_running_loop()
+            while True:
+                line = await loop.run_in_executor(None, input, prompt)
+                if not await call(line):
+                    break
+    except (EOFError, KeyboardInterrupt):
+        pass
+
+
+def run_repl_sync(handle, prompt: str, script: str = "") -> None:
+    asyncio.run(run_repl(handle, prompt, script))

@@ -64,18 +64,9 @@ def main() -> None:
     with ResponseCache(max_size=args.max_size, policy=args.policy,
                        default_ttl=args.default_ttl or None) as cache:
         print(f"cache: policy={args.policy} max_size={args.max_size}")
-        if args.script:
-            for line in args.script.split(";"):
-                print(f"> {line.strip()}")
-                if not handle(cache, line.strip()):
-                    break
-        else:
-            try:
-                while True:
-                    if not handle(cache, input("cache> ")):
-                        break
-            except (EOFError, KeyboardInterrupt):
-                pass
+        from _repl import run_repl_sync
+
+        run_repl_sync(lambda line: handle(cache, line), "cache> ", args.script)
 
 
 if __name__ == "__main__":

@@ -77,18 +77,9 @@ def main() -> None:
     args = ap.parse_args()
     _, router = build(args.workers, args.shards)
     print(f"router demo: {args.workers} workers, {args.shards} shards")
-    if args.script:
-        for line in args.script.split(";"):
-            print(f"> {line.strip()}")
-            if not handle(router, line.strip()):
-                break
-    else:
-        try:
-            while True:
-                if not handle(router, input("router> ")):
-                    break
-        except (EOFError, KeyboardInterrupt):
-            pass
+    from _repl import run_repl_sync
+
+    run_repl_sync(lambda line: handle(router, line), "router> ", args.script)
 
 
 if __name__ == "__main__":

@@ -82,25 +82,15 @@ async def handle(client: WorkerClient, line: str) -> bool:
 
 
 async def amain(script: str) -> None:
+    from _repl import run_repl
+
     w = WorkerServer(ServerConfig(worker_id="demo-worker", host="127.0.0.1",
                                   port=0))
     host, port = await w.start()
     print(f"worker on {host}:{port}")
     client = WorkerClient(host, port, timeout=600.0)
     try:
-        if script:
-            for line in script.split(";"):
-                print(f"> {line.strip()}")
-                if not await handle(client, line.strip()):
-                    break
-        else:
-            loop = asyncio.get_running_loop()
-            while True:
-                line = await loop.run_in_executor(None, input, "worker> ")
-                if not await handle(client, line):
-                    break
-    except (EOFError, KeyboardInterrupt):
-        pass
+        await run_repl(lambda line: handle(client, line), "worker> ", script)
     finally:
         await client.close()
         await w.stop()

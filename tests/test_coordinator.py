@@ -263,3 +263,20 @@ async def test_deploy_model_scale_out_is_idempotent():
         assert shard_ids == [0, 1, 2]
     finally:
         await stop_fleet(coord, workers)
+
+
+async def test_text_preproc_postproc():
+    """The README-declared preproc/postproc path: text in -> tokens through
+    the stack -> detokenized text out (byte tokenizer: fake echo engine
+    reverses the prompt bytes)."""
+    coord, workers = await make_fleet()
+    try:
+        out = await coord.submit("echo", text="abc", max_new_tokens=8)
+        assert out["tokens"] == [ord("c"), ord("b"), ord("a")]
+        assert out["text"] == "cba"
+        with pytest.raises(ValueError, match="not both"):
+            await coord.submit("echo", prompt=[1], text="x")
+        with pytest.raises(ValueError, match="empty prompt"):
+            await coord.submit("echo", text="")
+    finally:
+        await stop_fleet(coord, workers)

@@ -1,0 +1,84 @@
+"""Tokenizer layer: byte fallback, BPE correctness, native C++ core vs the
+pure-Python mirror (same ranked-merge algorithm, identical outputs)."""
+
+import json
+
+import pytest
+
+from distributed_inference_engine_tpu.utils.tokenizer import (
+    BPETokenizer,
+    ByteTokenizer,
+    _bytes_to_unicode,
+    _py_bpe_encode,
+    build_tokenizer,
+)
+
+
+def test_byte_tokenizer_roundtrip():
+    t = ByteTokenizer()
+    s = "hello, TPU! ünïcödé"
+    assert t.decode(t.encode(s)) == s
+    ids = t.encode("ab", add_bos=True, add_eos=True)
+    assert ids[0] == t.BOS and ids[-1] == t.EOS
+
+
+def _toy_bpe(**kw):
+    """Tiny hand-built vocab: bytes for 'abcd ' + merged units."""
+    b2u = _bytes_to_unicode()
+    base = [b2u[ord(c)] for c in "abcd "]
+    vocab = {u: i for i, u in enumerate(base)}
+    a, b, c, d = (b2u[ord(x)] for x in "abcd")
+    for unit in (a + b, c + d, a + b + c + d):
+        vocab[unit] = len(vocab)
+    merges = [(a, b), (c, d), (a + b, c + d)]
+    return BPETokenizer(vocab, merges, **kw)
+
+
+def test_bpe_merges_applied_in_rank_order():
+    t = _toy_bpe(use_native=False)
+    # "abcd" -> ab, cd -> abcd (one token)
+    assert len(t.encode("abcd")) == 1
+    assert t.encode("ab cd") != t.encode("abcd")
+    assert t.decode(t.encode("abcd ab")) == "abcd ab"
+
+
+def test_native_matches_python():
+    t_native = _toy_bpe(use_native=True)
+    t_py = _toy_bpe(use_native=False)
+    if not t_native.native_enabled:
+        pytest.skip("no native toolchain")
+    for text in ["", "a", "abcd", "ab cd abcd", "dcba", "abcabcd abcd d",
+                 "aaaa bbbb abab"]:
+        assert t_native.encode(text) == t_py.encode(text), text
+
+
+def test_native_matches_python_fuzz():
+    import random
+
+    t_native = _toy_bpe(use_native=True)
+    t_py = _toy_bpe(use_native=False)
+    if not t_native.native_enabled:
+        pytest.skip("no native toolchain")
+    rng = random.Random(0)
+    for _ in range(50):
+        s = "".join(rng.choice("abcd ") for _ in range(rng.randrange(1, 60)))
+        assert t_native.encode(s) == t_py.encode(s), s
+
+
+def test_bpe_from_pretrained_dir(tmp_path):
+    b2u = _bytes_to_unicode()
+    a, b = b2u[ord("a")], b2u[ord("b")]
+    vocab = {a: 0, b: 1, a + b: 2}
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text(f"#version\n{a} {b}\n")
+    t = BPETokenizer.from_pretrained_dir(str(tmp_path))
+    assert t.encode("ab") == [2]
+    assert t.decode([2, 0]) == "aba"
+    assert isinstance(build_tokenizer(str(tmp_path)), BPETokenizer)
+    assert isinstance(build_tokenizer(""), ByteTokenizer)
+
+
+def test_py_core_tie_break_is_leftmost():
+    # two applications of the same rank: leftmost merges first
+    ranks = {(0, 1): (0, 9)}
+    assert _py_bpe_encode([0, 1, 0, 1], ranks) == [9, 9]
