@@ -96,7 +96,7 @@ class Coordinator:
         self._cache_hits = 0
         self._submitted = 0
         self._model_configs: Dict[str, ModelConfig] = {}
-        self._tokenizers: Dict[str, Any] = {}   # model -> tokenizer (preproc)
+        self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -110,6 +110,14 @@ def build_engine(cfg: ModelConfig):
 EngineFactory = Callable[[ModelConfig], Any]
 
 
+def _model_identity(cfg: ModelConfig):
+    """The fields that determine WHICH model an engine serves. Engine-impl
+    knobs (continuous mode, page sizes, batch limits, schemas) are worker-
+    local choices and deliberately excluded — see ``load_model``."""
+    return (cfg.name, cfg.version, cfg.architecture, cfg.path, cfg.dtype,
+            cfg.quantized, str(cfg.metadata.get("size", "")))
+
+
 # --------------------------------------------------------------------------
 # server
 
@@ -200,10 +208,12 @@ class WorkerServer(FramedServerMixin):
 
     def load_model(self, cfg: ModelConfig) -> None:
         if cfg.name in self.engines:
-            # idempotent for an identical config (a worker preloaded via CLI
-            # is a valid deploy target); a DIFFERENT config is a real error —
-            # silently serving mismatched engines corrupts placement
-            if self.model_configs[cfg.name].to_dict() == cfg.to_dict():
+            # idempotent when the MODEL IDENTITY matches (a worker preloaded
+            # via CLI is a valid deploy target even if its engine knobs —
+            # continuous, page sizes, batcher limits — differ from the deploy
+            # request's defaults); a different identity is a real error:
+            # silently serving mismatched weights corrupts placement
+            if _model_identity(self.model_configs[cfg.name]) == _model_identity(cfg):
                 logger.info("worker %s: model %s already loaded (idempotent)",
                             self.worker_id, cfg.name)
                 return

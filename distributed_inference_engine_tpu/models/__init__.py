@@ -9,7 +9,7 @@ from .base import (  # noqa: F401
     unembed,
 )
 from .gpt2 import gpt2_spec  # noqa: F401
-from .llama import llama_spec  # noqa: F401
+from .llama import llama_spec, mixtral_spec  # noqa: F401
 from .fake import FakeEngine  # noqa: F401
 
 
@@ -29,6 +29,8 @@ def build_engine(architecture: str, **kwargs):
             "gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl") else "gpt2")
     elif architecture.startswith("llama"):
         spec = llama_spec(architecture if "-" in architecture else "llama3-8b")
+    elif architecture.startswith("mixtral"):
+        spec = mixtral_spec(architecture if "-" in architecture else "mixtral-8x7b")
     else:
         raise ValueError(f"unknown architecture {architecture!r}")
     real_keys = ("params", "config", "seed", "shard_fn")
@@ -45,6 +47,9 @@ def spec_for_architecture(architecture: str, size: str = "",
     if architecture.startswith("llama"):
         name = size or (architecture if "-" in architecture else "llama3-8b")
         return llama_spec(name, **overrides)
+    if architecture.startswith("mixtral"):
+        name = size or (architecture if "-" in architecture else "mixtral-8x7b")
+        return mixtral_spec(name, **overrides)
     raise ValueError(f"unknown architecture {architecture!r}")
 
 

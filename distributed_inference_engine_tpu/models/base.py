@@ -62,6 +62,11 @@ class ModelSpec:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # Mixture-of-experts (0 = dense). Experts replace the MLP; routing is
+    # top-`experts_per_token` with static capacity (ops/moe.py).
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -78,6 +83,14 @@ class ModelSpec:
             raise ValueError("n_heads must divide by n_kv_heads")
         if self.pos_emb not in ("rope", "learned"):
             raise ValueError(f"unknown pos_emb {self.pos_emb}")
+        if self.n_experts:
+            if not 1 <= self.experts_per_token <= self.n_experts:
+                raise ValueError(
+                    f"experts_per_token {self.experts_per_token} out of range "
+                    f"for {self.n_experts} experts"
+                )
+            if self.use_bias:
+                raise ValueError("MoE experts do not support biases")
         return self
 
     def to_dict(self) -> Dict[str, Any]:
@@ -114,7 +127,11 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
         "wv": norm_((L, D, Hkv * Dh), next(keys)),
         "wo": norm_((L, H * Dh, D), next(keys), out_std),
     }
-    if spec.mlp == "swiglu":
+    if spec.n_experts:
+        from ..ops.moe import init_moe_blocks
+
+        blocks.update(init_moe_blocks(spec, keys, norm_))
+    elif spec.mlp == "swiglu":
         blocks["w_gate"] = norm_((L, D, F), next(keys))
         blocks["w_up"] = norm_((L, D, F), next(keys))
         blocks["w_down"] = norm_((L, F, D), next(keys), out_std)
@@ -156,6 +173,12 @@ def _norm(spec: ModelSpec, x, scale, bias):
 
 
 def _mlp(spec: ModelSpec, blk: Params, x):
+    """Feed-forward block -> (out, moe_aux_loss). Dense blocks report aux 0
+    so every layer body has one static structure for lax.scan."""
+    if spec.n_experts:
+        from ..ops.moe import moe_mlp
+
+        return moe_mlp(spec, blk, x)
     if spec.mlp == "swiglu":
         gate = jnp.einsum("btd,df->btf", x, blk["w_gate"])
         up = jnp.einsum("btd,df->btf", x, blk["w_up"])
@@ -168,7 +191,7 @@ def _mlp(spec: ModelSpec, blk: Params, x):
     out = jnp.einsum("btf,fd->btd", h, blk["w_down"])
     if spec.use_bias:
         out = out + blk["b_down"]
-    return out
+    return out, jnp.float32(0.0)
 
 
 def _qkv(spec: ModelSpec, blk: Params, x, positions):
@@ -226,6 +249,17 @@ def forward_prefill(
     Returns (hidden [B, T, D], k_cache [L, B, T, Hkv, Dh], v_cache [L, ...]):
     the per-layer K/V to be written into cache slots by the engine.
     """
+    x, ks, vs, _ = _prefill_scan(spec, params, tokens, seq_lens)
+    return x, ks, vs
+
+
+def _prefill_scan(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """forward_prefill plus the summed MoE router aux loss (0 for dense)."""
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     x = embed(spec, params, tokens, positions)
@@ -236,11 +270,12 @@ def forward_prefill(
         attn = causal_attention(q, k, v, seq_lens)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        x = x + _mlp(spec, blk, h2)
-        return x, (k, v)
+        m, aux = _mlp(spec, blk, h2)
+        x = x + m
+        return x, (k, v, aux)
 
-    x, (ks, vs) = lax.scan(body, x, params["blocks"])
-    return x, ks, vs
+    x, (ks, vs, auxs) = lax.scan(body, x, params["blocks"])
+    return x, ks, vs, auxs.sum()
 
 
 # ------------------------------------------------------------------- decode
@@ -274,7 +309,8 @@ def forward_decode(
         attn = cached_attention(q, ck, cv, lengths + 1)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        x = x + _mlp(spec, blk, h2)
+        m, _ = _mlp(spec, blk, h2)
+        x = x + m
         return x, (ck, cv)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
@@ -338,7 +374,8 @@ def forward_decode_paged(
         )
         x = x + _out_proj(spec, blk, attn[:, None])
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        x = x + _mlp(spec, blk, h2)
+        m, _ = _mlp(spec, blk, h2)
+        x = x + m
         return x, (kp, vp)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], k_pages, v_pages))
@@ -398,17 +435,33 @@ def forward_train(
     return unembed(spec, params, hidden)
 
 
+def forward_train_aux(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B, T]
+    seq_lens: jnp.ndarray,   # [B]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(logits [B, T, V] fp32, summed MoE router aux loss — 0 for dense)."""
+    hidden, _, _, aux = _prefill_scan(spec, params, tokens, seq_lens)
+    return unembed(spec, params, hidden), aux
+
+
 def causal_lm_loss(
     spec: ModelSpec,
     params: Params,
     tokens: jnp.ndarray,     # [B, T]
     seq_lens: jnp.ndarray,   # [B]
+    router_aux_coef: float = 0.01,
 ) -> jnp.ndarray:
-    """Mean next-token cross-entropy over valid positions."""
-    logits = forward_train(spec, params, tokens, seq_lens)   # [B, T, V]
+    """Mean next-token cross-entropy over valid positions, plus the MoE
+    load-balance penalty when the spec routes experts."""
+    logits, aux = forward_train_aux(spec, params, tokens, seq_lens)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     t = tokens.shape[1]
     valid = (jnp.arange(t - 1)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
-    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+    loss = (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+    if spec.n_experts:
+        loss = loss + router_aux_coef * aux
+    return loss

@@ -42,3 +42,41 @@ def llama_spec(size: str = "llama3-8b", **overrides) -> ModelSpec:
     )
     base.update(overrides)
     return ModelSpec(**base).validate()
+
+
+# name: (layers, d_model, heads, kv_heads, d_ff, vocab, theta, max_seq, E, k)
+_MOE_FAMILY = {
+    "mixtral-8x7b": (32, 4096, 32, 8, 14336, 32000, 1e6, 32768, 8, 2),
+    "mixtral-tiny": (4, 256, 8, 4, 256, 1024, 10000.0, 512, 4, 2),
+}
+
+
+def mixtral_spec(size: str = "mixtral-8x7b", **overrides) -> ModelSpec:
+    """Mixtral family: Llama architecture with a routed-expert MLP
+    (``ops/moe.py``) — realizes the ``ep`` mesh axis SURVEY.md §2.3 reserves."""
+    if size not in _MOE_FAMILY:
+        raise ValueError(
+            f"unknown mixtral size {size!r}; choose from {sorted(_MOE_FAMILY)}"
+        )
+    (layers, d_model, heads, kv_heads, d_ff, vocab, theta, max_seq,
+     n_experts, k) = _MOE_FAMILY[size]
+    base = dict(
+        vocab_size=vocab,
+        d_model=d_model,
+        n_layers=layers,
+        n_heads=heads,
+        n_kv_heads=kv_heads,
+        d_ff=d_ff,
+        max_seq_len=max_seq,
+        pos_emb="rope",
+        norm="rmsnorm",
+        mlp="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+        rope_theta=theta,
+        norm_eps=1e-5,
+        n_experts=n_experts,
+        experts_per_token=k,
+    )
+    base.update(overrides)
+    return ModelSpec(**base).validate()

@@ -86,9 +86,10 @@ def _map_gpt2(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
     }
 
 
-def _map_llama(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
+def _map_llama_attn(raw: Dict[str, np.ndarray], spec: ModelSpec,
+                    pre: str) -> Dict[str, Any]:
+    """The Llama-family tree minus the MLP weights (shared with Mixtral)."""
     L = spec.n_layers
-    pre = "" if "model.embed_tokens.weight" not in raw else "model."
     blocks = {
         "ln1_scale": _stack(raw, pre + "layers.{}.input_layernorm.weight", L),
         "ln2_scale": _stack(raw, pre + "layers.{}.post_attention_layernorm.weight", L),
@@ -96,9 +97,6 @@ def _map_llama(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
         "wk": _stack(raw, pre + "layers.{}.self_attn.k_proj.weight", L, transpose=True),
         "wv": _stack(raw, pre + "layers.{}.self_attn.v_proj.weight", L, transpose=True),
         "wo": _stack(raw, pre + "layers.{}.self_attn.o_proj.weight", L, transpose=True),
-        "w_gate": _stack(raw, pre + "layers.{}.mlp.gate_proj.weight", L, transpose=True),
-        "w_up": _stack(raw, pre + "layers.{}.mlp.up_proj.weight", L, transpose=True),
-        "w_down": _stack(raw, pre + "layers.{}.mlp.down_proj.weight", L, transpose=True),
     }
     emb_key = (pre + "embed_tokens.weight") if pre else "embed_tokens.weight"
     params = {
@@ -113,6 +111,50 @@ def _map_llama(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
     return params
 
 
+def _map_llama(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
+    L = spec.n_layers
+    pre = "" if "model.embed_tokens.weight" not in raw else "model."
+    params = _map_llama_attn(raw, spec, pre)
+    params["blocks"].update({
+        "w_gate": _stack(raw, pre + "layers.{}.mlp.gate_proj.weight", L, transpose=True),
+        "w_up": _stack(raw, pre + "layers.{}.mlp.up_proj.weight", L, transpose=True),
+        "w_down": _stack(raw, pre + "layers.{}.mlp.down_proj.weight", L, transpose=True),
+    })
+    return params
+
+
+def _map_mixtral(raw: Dict[str, np.ndarray], spec: ModelSpec) -> Dict[str, Any]:
+    """HF Mixtral naming: the attention/norm tree is Llama's; the MLP is
+    ``block_sparse_moe.gate`` (router) + per-expert ``w1``(gate)/``w2``(down)/
+    ``w3``(up) linears, stacked here onto a leading expert axis [L, E, ...]."""
+    L, E = spec.n_layers, spec.n_experts
+    pre = "" if "model.embed_tokens.weight" not in raw else "model."
+    tree = _map_llama_attn(raw, spec, pre)
+
+    def experts(w: str, transpose: bool) -> np.ndarray:
+        per_layer = []
+        for layer in range(L):
+            mats = []
+            for e in range(E):
+                name = (f"{pre}layers.{layer}.block_sparse_moe."
+                        f"experts.{e}.{w}.weight")
+                if name not in raw:
+                    raise KeyError(f"checkpoint missing tensor {name}")
+                mats.append(raw[name].T if transpose else raw[name])
+            per_layer.append(np.stack(mats))
+        return np.stack(per_layer)                       # [L, E, ...]
+
+    tree["blocks"].update({
+        "w_router": _stack(
+            raw, pre + "layers.{}.block_sparse_moe.gate.weight", L,
+            transpose=True),                              # [L, D, E]
+        "w_gate": experts("w1", transpose=True),          # [L, E, D, F]
+        "w_down": experts("w2", transpose=True),          # [L, E, F, D]
+        "w_up": experts("w3", transpose=True),            # [L, E, D, F]
+    })
+    return tree
+
+
 def load_checkpoint(path: str, spec: ModelSpec) -> Params:
     """Load a local HF checkpoint dir into the stacked param tree, cast to
     ``spec.dtype``."""
@@ -120,6 +162,8 @@ def load_checkpoint(path: str, spec: ModelSpec) -> Params:
     raw = _load_raw(p)
     if any(k.endswith("wte.weight") for k in raw):
         tree = _map_gpt2(raw, spec)
+    elif any("block_sparse_moe" in k for k in raw):
+        tree = _map_mixtral(raw, spec)
     elif any(k.endswith("embed_tokens.weight") for k in raw):
         tree = _map_llama(raw, spec)
     else:
@@ -158,6 +202,25 @@ def spec_from_hf_config(path: str) -> ModelSpec:
             use_bias=True,
             tie_embeddings=True,
             norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+        ).validate()
+    if "mixtral" in arch or cfg.get("model_type") == "mixtral":
+        return ModelSpec(
+            vocab_size=cfg["vocab_size"],
+            d_model=cfg["hidden_size"],
+            n_layers=cfg["num_hidden_layers"],
+            n_heads=cfg["num_attention_heads"],
+            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            d_ff=cfg["intermediate_size"],
+            max_seq_len=cfg.get("max_position_embeddings", 32768),
+            pos_emb="rope",
+            norm="rmsnorm",
+            mlp="swiglu",
+            use_bias=False,
+            tie_embeddings=cfg.get("tie_word_embeddings", False),
+            rope_theta=cfg.get("rope_theta", 1e6),
+            norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            n_experts=cfg["num_local_experts"],
+            experts_per_token=cfg.get("num_experts_per_tok", 2),
         ).validate()
     if "llama" in arch or cfg.get("model_type") == "llama":
         return ModelSpec(

@@ -87,7 +87,6 @@ int32_t bpe_encode(void* handle, const int32_t* ids, int32_t n, int32_t* out) {
   };
   for (int32_t i = 0; i < n; ++i) push_pair(i);
 
-  int32_t alive = n;
   std::vector<bool> dead(n, false);
   while (!heap.empty()) {
     const HeapItem item = heap.top();
@@ -103,7 +102,6 @@ int32_t bpe_encode(void* handle, const int32_t* ids, int32_t n, int32_t* out) {
     tok[pos] = it->second.second;
     ++stamp[pos];
     dead[nx] = true;
-    --alive;
     const int32_t nn = next[nx];
     next[pos] = nn;
     if (nn >= 0) prev[nn] = pos;

@@ -1,0 +1,114 @@
+"""Mixture-of-experts MLP: top-k token-choice routing, capacity-bounded
+dense dispatch, experts sharded over the ``ep`` mesh axis.
+
+No reference counterpart exists (the reference has no model math at all —
+SURVEY.md §2.3 lists expert parallelism as "mesh axis reserved"); this
+realizes that reserved axis. The design is the TPU-classic GShard/Switch
+shape rather than a scatter/gather kernel:
+
+- **Routing** is a tiny fp32 matmul + ``lax.top_k``; top-k gate weights are
+  renormalized (Mixtral convention).
+- **Dispatch/combine are einsums against one-hot tensors** ``[n, E, C]``
+  (n tokens, E experts, C capacity slots). That keeps every FLOP on the MXU
+  with fully static shapes — no dynamic gather, nothing XLA can't tile.
+- **Capacity** is static: ``C = ceil(n·k/E · capacity_factor)``. Tokens that
+  overflow an expert's capacity are dropped from that expert (their one-hot
+  slot index lands out of range, so the dispatch row is all-zero) and the
+  residual connection carries them through — standard Switch behavior.
+- **Expert parallelism**: expert weights carry a leading ``E`` axis sharded
+  over ``ep`` (``parallel/sharding.py``); GSPMD turns the dispatch einsum
+  into the all-to-all over ICI. Inside each expert the FFN dims still shard
+  over ``tp``, so ep×tp compose.
+
+Also returns the Switch-style load-balancing auxiliary loss (E · Σ_e f_e·P_e,
+=1 at perfect balance) so the training step can regularize routing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_capacity(n_tokens: int, n_experts: int, experts_per_token: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert capacity for a batch of ``n_tokens`` tokens."""
+    c = math.ceil(n_tokens * experts_per_token / n_experts * capacity_factor)
+    return max(int(c), experts_per_token)
+
+
+def moe_mlp(
+    spec,                       # ModelSpec (avoid circular import)
+    blk: Dict[str, Any],        # one layer's params: w_router + expert FFN
+    x: jnp.ndarray,             # [B, T, D]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE feed-forward over a token batch.
+
+    Returns (out [B, T, D], aux_loss scalar fp32). Dropped (over-capacity)
+    tokens contribute zero here; the caller's residual stream carries them.
+    """
+    b, t, d = x.shape
+    E, K = spec.n_experts, spec.experts_per_token
+    n = b * t
+    C = moe_capacity(n, E, K, spec.capacity_factor)
+    xf = x.reshape(n, d)
+
+    # --- route (fp32: tiny, and router logits are precision-sensitive)
+    logits = jnp.einsum(
+        "nd,de->ne", xf.astype(jnp.float32), blk["w_router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)                    # [n, E]
+    gate, idx = lax.top_k(probs, K)                            # [n, K]
+    gate = gate / jnp.maximum(gate.sum(axis=-1, keepdims=True), 1e-9)
+
+    # --- capacity assignment. GShard priority order: all tokens' choice-0
+    # first, then choice-1, ... so a token's primary expert wins slots over
+    # another token's backup.
+    assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)         # [n, K, E]
+    flat = assign.transpose(1, 0, 2).reshape(K * n, E)         # choice-major
+    pos = jnp.cumsum(flat, axis=0) - flat                      # slots used before
+    pos = pos.reshape(K, n, E).transpose(1, 0, 2)              # [n, K, E]
+    slot = jnp.sum(pos * assign, axis=-1).astype(jnp.int32)    # [n, K]
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32)       # [n, K, C]; >=C -> 0
+    dispatch = jnp.einsum("nke,nkc->nec", assign, slot_oh)     # [n, E, C] 0/1
+    combine = jnp.einsum("nke,nkc->nec", assign * gate[..., None], slot_oh)
+
+    # --- dispatch -> expert FFN -> combine (all MXU einsums)
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch, xf.astype(jnp.float32)
+    ).astype(x.dtype)                                          # [E, C, D]
+    if spec.mlp == "swiglu":
+        g = jnp.einsum("ecd,edf->ecf", expert_in, blk["w_gate"])
+        u = jnp.einsum("ecd,edf->ecf", expert_in, blk["w_up"])
+        h = jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u
+    else:
+        u = jnp.einsum("ecd,edf->ecf", expert_in, blk["w_up"])
+        h = jax.nn.gelu(u.astype(jnp.float32), approximate=True).astype(x.dtype)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, blk["w_down"])  # [E, C, D]
+    out = jnp.einsum(
+        "nec,ecd->nd", combine, expert_out.astype(jnp.float32)
+    ).astype(x.dtype)
+
+    # --- Switch load-balance loss: E * Σ_e (dispatch fraction · mean prob)
+    frac = assign.sum(axis=(0, 1)) / float(n * K)              # [E], sums to 1
+    mean_prob = probs.mean(axis=0)                             # [E]
+    aux = jnp.float32(E) * jnp.sum(frac * mean_prob)
+    return out.reshape(b, t, d), aux
+
+
+def init_moe_blocks(spec, keys, norm_init) -> Dict[str, jnp.ndarray]:
+    """Expert-FFN + router params for the stacked block tree ([L, E, ...])."""
+    L, D, F, E = spec.n_layers, spec.d_model, spec.d_ff, spec.n_experts
+    out_std = 0.02 / math.sqrt(2.0 * L)
+    blocks: Dict[str, jnp.ndarray] = {
+        "w_router": norm_init((L, D, E), next(keys)),
+        "w_up": norm_init((L, E, D, F), next(keys)),
+        "w_down": norm_init((L, E, F, D), next(keys), out_std),
+    }
+    if spec.mlp == "swiglu":
+        blocks["w_gate"] = norm_init((L, E, D, F), next(keys))
+    return blocks

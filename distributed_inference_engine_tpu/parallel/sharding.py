@@ -50,11 +50,20 @@ def param_pspecs(spec: ModelSpec) -> Dict[str, Any]:
         "wv": P(None, None, "tp"),
         # row-parallel: input features over tp (XLA psums the partial sums)
         "wo": P(None, "tp", None),
-        "w_up": P(None, None, "tp"),
-        "w_down": P(None, "tp", None),
     }
-    if spec.mlp == "swiglu":
-        blocks["w_gate"] = P(None, None, "tp")
+    if spec.n_experts:
+        # expert axis over ep (GSPMD lowers the dispatch einsum to the
+        # all-to-all); inside each expert the FFN dims still shard over tp.
+        blocks["w_router"] = P()
+        blocks["w_up"] = P(None, "ep", None, "tp")
+        blocks["w_down"] = P(None, "ep", "tp", None)
+        if spec.mlp == "swiglu":
+            blocks["w_gate"] = P(None, "ep", None, "tp")
+    else:
+        blocks["w_up"] = P(None, None, "tp")
+        blocks["w_down"] = P(None, "tp", None)
+        if spec.mlp == "swiglu":
+            blocks["w_gate"] = P(None, None, "tp")
     if spec.norm == "layernorm":
         blocks["ln1_bias"] = P()
         blocks["ln2_bias"] = P()
