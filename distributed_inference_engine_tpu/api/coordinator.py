@@ -40,6 +40,7 @@ from ..cluster.load_balancer import (
 from ..cluster.registry import ModelRegistry, ModelStatus
 from ..cluster.router import Router, RoutingError
 from ..cluster.worker import (
+    DECODE_PEER_UNREACHABLE,
     WorkerClient,
     WorkerRPCError,
     request_from_dict,
@@ -71,6 +72,18 @@ class CoordinatorConfig:
         return cls(batcher=cfg.batcher, cache=cfg.cache, health=cfg.health)
 
 
+@dataclass
+class _DisaggPool:
+    """Pool membership for one disaggregated deployment. Decode placement
+    lives in the registry (decode workers are the model's shards, so KV
+    affinity and failover reuse the router); prefill workers are stateless
+    and picked round-robin over the healthy subset."""
+
+    prefill_ids: List[str]
+    decode_ids: List[str]
+    rr: int = 0
+
+
 class Coordinator:
     """The engine-of-engines: one object that owns the whole control plane."""
 
@@ -97,6 +110,8 @@ class Coordinator:
         self._submitted = 0
         self._model_configs: Dict[str, ModelConfig] = {}
         self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
+        # disaggregated deployments: model -> (prefill worker ids, rr cursor)
+        self._disagg: Dict[str, "_DisaggPool"] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -166,6 +181,80 @@ class Coordinator:
             next_id += 1
             deployed += 1
         return deployed
+
+    async def deploy_model_disaggregated(
+        self,
+        cfg: ModelConfig,
+        prefill_worker_ids: Sequence[str],
+        decode_worker_ids: Sequence[str],
+        load_timeout_s: float = 600.0,
+    ) -> Tuple[int, int]:
+        """Disaggregated deployment (BASELINE.json configs[4]; SURVEY.md §2.3
+        last row): load a prefill-only engine onto the prefill pool and a
+        continuous decode engine onto the decode pool.
+
+        Requests then flow coordinator → prefill worker → (KV over DCN) →
+        decode worker → results back. Decode workers are registered as the
+        model's shards, so affinity routing and deterministic failover apply
+        to the stateful half of the pair; prefill workers are stateless and
+        rotate round-robin. Returns (#prefill, #decode) workers loaded.
+        """
+        if not prefill_worker_ids or not decode_worker_ids:
+            raise ValueError("both pools need at least one worker")
+        overlap = set(prefill_worker_ids) & set(decode_worker_ids)
+        if overlap:
+            raise ValueError(f"workers in both pools: {sorted(overlap)}")
+        unknown = [w for w in (*prefill_worker_ids, *decode_worker_ids)
+                   if w not in self.router.workers]
+        if unknown:
+            raise RoutingError(f"unknown workers: {unknown}")
+
+        pcfg = ModelConfig.from_dict(cfg.to_dict())
+        pcfg.metadata = dict(cfg.metadata, role="prefill")
+        pcfg.metadata.pop("continuous", None)
+        dcfg = ModelConfig.from_dict(cfg.to_dict())
+        dcfg.metadata = dict(cfg.metadata, continuous=1)
+        dcfg.metadata.pop("role", None)
+
+        if self.registry.get_model_version(cfg.name, cfg.version) is None:
+            self.registry.register_model(cfg)
+        self._model_configs[cfg.name] = cfg
+        for wid in prefill_worker_ids:
+            await self.router.client_for(wid).load_model(
+                pcfg, timeout=load_timeout_s)
+        existing = self.registry.all_shards(cfg.name, cfg.version)
+        hosted = {s.worker_id for s in existing}
+        next_id = max((s.shard_id for s in existing), default=-1) + 1
+        for wid in decode_worker_ids:
+            # a worker preloaded with a static engine is rejected by the
+            # worker's own load_model (feature-superset check) — a failure
+            # here leaves a partial deploy that is safe to resume: _disagg
+            # is not set yet and re-deploy skips already-hosted shards
+            await self.router.client_for(wid).load_model(
+                dcfg, timeout=load_timeout_s)
+            if wid not in hosted:
+                self.registry.add_shard(cfg.name, cfg.version,
+                                        shard_id=next_id, worker_id=wid,
+                                        status=ModelStatus.READY)
+                next_id += 1
+        self._disagg[cfg.name] = _DisaggPool(
+            prefill_ids=list(prefill_worker_ids),
+            decode_ids=list(decode_worker_ids),
+        )
+        return len(prefill_worker_ids), len(decode_worker_ids)
+
+    def _pick_prefill_worker(self, pool: _DisaggPool) -> str:
+        """Round-robin over prefill workers the router considers usable."""
+        from ..cluster.router import WorkerHealth
+
+        n = len(pool.prefill_ids)
+        for i in range(n):
+            wid = pool.prefill_ids[(pool.rr + i) % n]
+            info = self.router.workers.get(wid)
+            if info is not None and info.health is not WorkerHealth.UNHEALTHY:
+                pool.rr = (pool.rr + i + 1) % n
+                return wid
+        raise RoutingError("no healthy prefill worker")
 
     # -- request path -------------------------------------------------------
 
@@ -336,11 +425,30 @@ class Coordinator:
             # _dispatch_once already marked the failure — don't double-count
             logger.warning("dispatch to %s failed (%s: %s) — retrying on "
                            "alternate", worker_id, type(e).__name__, e)
+            if model in self._disagg:
+                # disaggregated: the failure was the (stateless) prefill
+                # worker, already marked; re-dispatch re-picks from the
+                # healthy remainder — decode target unchanged
+                return await self._dispatch_once(model, worker_id, reqs)
             alt = self._pick_alternate(model, version, worker_id,
                                        keys[0], sharded)
             if alt is None:
                 raise
             return await self._dispatch_once(model, alt, reqs)
+        except WorkerRPCError as e:
+            # disaggregated relay reporting its decode peer down: the
+            # decode worker was already marked in _dispatch_disagg_once —
+            # retry once on an alternate decode shard
+            if (model in self._disagg
+                    and getattr(e, "kind", "") == DECODE_PEER_UNREACHABLE):
+                logger.warning("decode peer behind %s down (%s) — retrying "
+                               "on alternate decode shard", worker_id, e)
+                alt = self._pick_alternate(model, version, worker_id,
+                                           keys[0], sharded)
+                if alt is None:
+                    raise
+                return await self._dispatch_once(model, alt, reqs)
+            raise
 
     def _pick_alternate(self, model: str, version: str, failed: str,
                         key: str, sharded: bool) -> Optional[str]:
@@ -362,6 +470,10 @@ class Coordinator:
 
     async def _dispatch_once(self, model: str, worker_id: str,
                              reqs: List) -> List[Dict[str, Any]]:
+        pool = self._disagg.get(model)
+        if pool is not None:
+            return await self._dispatch_disagg_once(model, pool,
+                                                    worker_id, reqs)
         client = (self.router.client_for(worker_id)
                   if worker_id in self.router.workers
                   else self.lb.client_for(worker_id))
@@ -393,6 +505,58 @@ class Coordinator:
             out.append(d)
         return out
 
+    async def _dispatch_disagg_once(
+        self, model: str, pool: _DisaggPool, decode_wid: str, reqs: List,
+    ) -> List[Dict[str, Any]]:
+        """One disaggregated dispatch: requests go to a prefill worker,
+        which hands the KV to ``decode_wid`` (the router-chosen shard) and
+        relays the finished results.
+
+        Health accounting targets the prefill worker — it is the peer this
+        coordinator actually talked to. A decode worker that died mid-decode
+        surfaces as a ``WorkerRPCError`` relayed by the prefill worker; the
+        router's own health probes (not this path) take the decode worker
+        out of the shard rotation within a probe interval.
+        """
+        pwid = self._pick_prefill_worker(pool)
+        pclient = self.router.client_for(pwid)
+        dinfo = self.router.workers[decode_wid]
+        self.lb.acquire(pwid)
+        t0 = time.perf_counter()
+        try:
+            results = await pclient.prefill_generate(
+                model, reqs, decode_host=dinfo.host, decode_port=dinfo.port,
+                timeout=self.config.dispatch_timeout_s,
+            )
+        except Exception as e:
+            if getattr(e, "kind", "") == DECODE_PEER_UNREACHABLE:
+                # the prefill worker is fine — it reported its decode peer
+                # down; dent the DECODE worker so routing moves off it now
+                # instead of waiting for a health-probe interval
+                self.router.mark_worker_failure(decode_wid)
+                self.lb.update_stats(decode_wid, success=False,
+                                     latency_s=time.perf_counter() - t0)
+            else:
+                self.lb.update_stats(pwid, success=False,
+                                     latency_s=time.perf_counter() - t0)
+                if not isinstance(e, WorkerRPCError):
+                    self.router.mark_worker_failure(pwid)
+            raise
+        finally:
+            self.lb.release(pwid)
+        self.lb.update_stats(pwid, success=True,
+                             latency_s=time.perf_counter() - t0)
+        self.router.mark_worker_success(pwid)
+        self.router.mark_worker_success(decode_wid)  # round-trip proves it live
+        out = []
+        for r in results:
+            d = result_to_dict(r)
+            d["metadata"]["worker_id"] = f"{pwid}+{decode_wid}"
+            d["metadata"]["prefill_worker"] = pwid
+            d["metadata"]["decode_worker"] = decode_wid
+            out.append(d)
+        return out
+
     # -- introspection ------------------------------------------------------
 
     def get_stats(self) -> Dict[str, Any]:
@@ -404,4 +568,8 @@ class Coordinator:
             "router": self.router.get_stats(),
             "load_balancer": self.lb.get_all_stats(),
             "registry": self.registry.get_stats(),
+            "disaggregated": {
+                m: {"prefill": p.prefill_ids, "decode": p.decode_ids}
+                for m, p in self._disagg.items()
+            },
         }

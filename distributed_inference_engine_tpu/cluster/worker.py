@@ -42,6 +42,18 @@ from ..utils.tracing import LatencyStats
 
 logger = logging.getLogger(__name__)
 
+# machine-readable error class for the disaggregated relay: the decode peer
+# could not be reached / died mid-decode. The coordinator reacts by marking
+# the DECODE worker and retrying on an alternate shard (the prefill worker
+# that reports this is itself healthy).
+DECODE_PEER_UNREACHABLE = "decode_peer_unreachable"
+
+
+class DecodePeerError(RuntimeError):
+    """Transport failure between a prefill worker and its decode peer."""
+
+    rpc_error_kind = DECODE_PEER_UNREACHABLE
+
 
 # --------------------------------------------------------------------------
 # request/result wire marshalling (token-id space; tokenization is a client/
@@ -118,6 +130,21 @@ def _model_identity(cfg: ModelConfig):
             cfg.quantized, str(cfg.metadata.get("size", "")))
 
 
+def _engine_features(cfg: ModelConfig) -> frozenset:
+    """The RPC surface an engine config provides. Idempotent re-load is
+    allowed only when the hosted engine provides a SUPERSET of what the new
+    deploy needs — unlike the engine knobs ``_model_identity`` ignores, a
+    missing feature silently blackholes a pool's traffic (e.g. a static
+    engine in a decode pool can't serve ``generate_prefilled``). The check
+    is directional: a continuous preload is a fine target for a plain
+    deploy, the reverse is not."""
+    if cfg.metadata.get("role") == "prefill":
+        return frozenset({"prefill"})
+    if cfg.metadata.get("continuous"):
+        return frozenset({"generate", "generate_prefilled"})
+    return frozenset({"generate"})
+
+
 # --------------------------------------------------------------------------
 # server
 
@@ -155,12 +182,19 @@ class WorkerServer(FramedServerMixin):
         self._methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]] = {
             "ping": self._rpc_ping,
             "generate": self._rpc_generate,
+            "prefill": self._rpc_prefill,
+            "generate_prefilled": self._rpc_generate_prefilled,
+            "prefill_generate": self._rpc_prefill_generate,
             "load_model": self._rpc_load_model,
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
             "metrics": self._rpc_metrics,
             "shutdown": self._rpc_shutdown,
         }
+        # prefill-pool side: persistent clients to decode-pool peers,
+        # keyed by (host, port) — the KV handoff goes peer-to-peer over
+        # DCN, not back through the coordinator
+        self._peer_clients: Dict[Tuple[str, int], "WorkerClient"] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -195,6 +229,9 @@ class WorkerServer(FramedServerMixin):
             self._server = None
         for pump in self._pumps.values():
             pump.shutdown_nowait()
+        for client in self._peer_clients.values():
+            await client.close()
+        self._peer_clients.clear()
         self._executor.shutdown(wait=False, cancel_futures=True)
         self._shutdown_event.set()
         logger.info("worker %s stopped", self.worker_id)
@@ -213,13 +250,21 @@ class WorkerServer(FramedServerMixin):
             # continuous, page sizes, batcher limits — differ from the deploy
             # request's defaults); a different identity is a real error:
             # silently serving mismatched weights corrupts placement
-            if _model_identity(self.model_configs[cfg.name]) == _model_identity(cfg):
-                logger.info("worker %s: model %s already loaded (idempotent)",
-                            self.worker_id, cfg.name)
-                return
-            raise ValueError(
-                f"model {cfg.name!r} already loaded with a different config"
-            )
+            have = self.model_configs[cfg.name]
+            if _model_identity(have) != _model_identity(cfg):
+                raise ValueError(
+                    f"model {cfg.name!r} already loaded with a different config"
+                )
+            need, got = _engine_features(cfg), _engine_features(have)
+            if not need <= got:
+                raise ValueError(
+                    f"model {cfg.name!r} already loaded with features "
+                    f"{sorted(got)} but this deploy needs {sorted(need)} "
+                    "— unload it first"
+                )
+            logger.info("worker %s: model %s already loaded (idempotent)",
+                        self.worker_id, cfg.name)
+            return
         t0 = time.perf_counter()
         engine = self.engine_factory(cfg)
         self.engines[cfg.name] = engine
@@ -274,7 +319,8 @@ class WorkerServer(FramedServerMixin):
         # generate/load_model legitimately run for minutes (first-call XLA
         # compile, checkpoint load) — their deadline belongs to the caller.
         # The server-side timeout only guards the cheap control methods.
-        if method in ("generate", "load_model"):
+        if method in ("generate", "load_model", "prefill",
+                      "generate_prefilled", "prefill_generate"):
             return await handler(msg)
         return await asyncio.wait_for(
             handler(msg), timeout=self.config.request_timeout
@@ -308,13 +354,7 @@ class WorkerServer(FramedServerMixin):
                 "models": sorted(self.engines)}
 
     async def _rpc_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        name = msg.get("model")
-        if not name:
-            raise ValueError("missing 'model'")
-        engine = self.engines.get(name)
-        if engine is None:
-            raise ValueError(f"model {name!r} not loaded "
-                             f"(have: {sorted(self.engines)})")
+        name, engine = self._engine_for(msg, "generate")
         reqs = [request_from_dict(d) for d in msg.get("requests", [])]
         if not reqs:
             raise ValueError("empty 'requests'")
@@ -331,6 +371,140 @@ class WorkerServer(FramedServerMixin):
                 self._executor, engine.generate, reqs
             )
         return {"model": name, "results": [result_to_dict(r) for r in results]}
+
+    # -- disaggregated prefill/decode (engine/disagg.py; SURVEY.md §2.3) ----
+
+    def _engine_for(self, msg: Dict[str, Any], capability: str):
+        name = msg.get("model")
+        if not name:
+            raise ValueError("missing 'model'")
+        engine = self.engines.get(name)
+        if engine is None:
+            raise ValueError(f"model {name!r} not loaded "
+                             f"(have: {sorted(self.engines)})")
+        if not hasattr(engine, capability):
+            raise ValueError(
+                f"model {name!r} engine ({type(engine).__name__}) does not "
+                f"support {capability!r} — wrong pool role?"
+            )
+        return name, engine
+
+    async def _rpc_prefill(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefill-pool op: run the prompt, return KV handoffs to the caller."""
+        from ..engine.disagg import handoff_to_wire
+
+        name, engine = self._engine_for(msg, "prefill")
+        reqs = [request_from_dict(d) for d in msg.get("requests", [])]
+        if not reqs:
+            raise ValueError("empty 'requests'")
+        self._request_count += 1
+        loop = asyncio.get_running_loop()
+        handoffs = await loop.run_in_executor(
+            self._executor, engine.prefill, reqs
+        )
+        return {"model": name,
+                "handoffs": [handoff_to_wire(h) for h in handoffs]}
+
+    async def _rpc_generate_prefilled(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Decode-pool op: admit handed-off KV, decode to completion."""
+        from ..engine.disagg import handoff_from_wire
+
+        name, _engine = self._engine_for(msg, "submit_prefilled")
+        pump = self._pumps.get(name)
+        if pump is None:
+            raise ValueError(
+                f"model {name!r} is not a continuous engine — the decode "
+                "pool needs metadata.continuous=1"
+            )
+        reqs = [request_from_dict(d) for d in msg.get("requests", [])]
+        handoffs = [handoff_from_wire(d) for d in msg.get("handoffs", [])]
+        if len(reqs) != len(handoffs) or not reqs:
+            raise ValueError("requests and handoffs must align and be non-empty")
+        self._request_count += 1
+        results = await pump.generate_prefilled(list(zip(reqs, handoffs)))
+        return {"model": name, "results": [result_to_dict(r) for r in results]}
+
+    async def _rpc_prefill_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefill-pool op: prefill locally, hand the KV to the decode peer
+        at (decode_host, decode_port), relay its finished results.
+
+        One KV hop (prefill → decode over DCN) — the coordinator only
+        carries requests and token results.
+        """
+        from ..engine.disagg import handoff_to_wire
+
+        name, engine = self._engine_for(msg, "prefill")
+        host, port = msg.get("decode_host"), msg.get("decode_port")
+        if not host or not port:
+            raise ValueError("missing 'decode_host'/'decode_port'")
+        reqs_wire = msg.get("requests", [])
+        reqs = [request_from_dict(d) for d in reqs_wire]
+        if not reqs:
+            raise ValueError("empty 'requests'")
+        self._request_count += 1
+        loop = asyncio.get_running_loop()
+        handoffs = await loop.run_in_executor(
+            self._executor, engine.prefill, reqs
+        )
+        peer = self._peer_clients.get((host, int(port)))
+        if peer is None:
+            peer = WorkerClient(host, int(port),
+                                max_frame=self.config.max_frame_bytes)
+            self._peer_clients[(host, int(port))] = peer
+
+        # KV handoffs are big (≈2·L·Hkv·Dh·itemsize bytes/token) — pack
+        # them into as many generate_prefilled frames as the frame limit
+        # needs. An oversize SINGLE handoff is a config error (raise it as
+        # one), never a DecodePeerError: misclassifying it would dent the
+        # healthy decode worker's health on every long prompt.
+        wires = [handoff_to_wire(h) for h in handoffs]
+        budget = self.config.max_frame_bytes - 1_048_576  # envelope headroom
+        sizes = [len(w["k"]) + len(w["v"]) + 4096 for w in wires]
+        for h, s in zip(handoffs, sizes):
+            if s > budget:
+                raise ValueError(
+                    f"handoff for request {h.request_id!r} is {s} bytes — "
+                    f"exceeds the {self.config.max_frame_bytes}-byte frame "
+                    "limit; raise ServerConfig.max_frame_bytes on both pools"
+                )
+        batches: List[Tuple[List[int], int]] = []   # (indices, bytes)
+        for i, s in enumerate(sizes):
+            if batches and batches[-1][1] + s <= budget:
+                batches[-1][0].append(i)
+                batches = [*batches[:-1], (batches[-1][0], batches[-1][1] + s)]
+            else:
+                batches.append(([i], s))
+
+        # peer_timeout travels IN the message (the client-side ``timeout``
+        # kwarg only bounds the caller's own read and is never serialized);
+        # sub-batches go concurrently — the decode pump merges them into
+        # one rolling batch
+        peer_timeout = float(msg.get("peer_timeout", 300.0))
+        decode_model = msg.get("decode_model", name)
+
+        async def _send(idxs: List[int]) -> Any:
+            return await peer.call(
+                "generate_prefilled", model=decode_model,
+                requests=[reqs_wire[i] for i in idxs],
+                handoffs=[wires[i] for i in idxs],
+                timeout=peer_timeout,
+            )
+
+        try:
+            parts = await asyncio.gather(*(_send(idxs)
+                                           for idxs, _ in batches))
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError, EOFError, FrameError) as e:
+            raise DecodePeerError(
+                f"decode peer {host}:{port} unreachable: "
+                f"{type(e).__name__}: {e}"
+            ) from e
+        results: List[Any] = [None] * len(reqs_wire)
+        for (idxs, _), part in zip(batches, parts):
+            for i, r in zip(idxs, part["results"]):
+                results[i] = r
+        return {"model": name, "results": results,
+                "decode_worker": f"{host}:{port}"}
 
     async def _rpc_load_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         cfg = ModelConfig.from_dict(msg["config"])
@@ -404,6 +578,57 @@ class WorkerClient(FramedRPCClient):
             "generate", model=model,
             requests=[request_to_dict(r) for r in requests],
             timeout=timeout,
+        )
+        return [result_from_dict(d) for d in result["results"]]
+
+    async def prefill(self, model: str, requests: List[GenerationRequest],
+                      timeout: Optional[float] = None) -> List[Any]:
+        """Prefill-pool call: returns ``PrefillHandoff`` objects."""
+        from ..engine.disagg import handoff_from_wire
+
+        result = await self.call(
+            "prefill", model=model,
+            requests=[request_to_dict(r) for r in requests],
+            timeout=timeout,
+        )
+        return [handoff_from_wire(d) for d in result["handoffs"]]
+
+    async def generate_prefilled(
+        self, model: str, requests: List[GenerationRequest],
+        handoffs: List[Any], timeout: Optional[float] = None,
+    ) -> List[GenerationResult]:
+        """Decode-pool call: requests + KV handoffs → finished results."""
+        from ..engine.disagg import handoff_to_wire
+
+        result = await self.call(
+            "generate_prefilled", model=model,
+            requests=[request_to_dict(r) for r in requests],
+            handoffs=[handoff_to_wire(h) for h in handoffs],
+            timeout=timeout,
+        )
+        return [result_from_dict(d) for d in result["results"]]
+
+    async def prefill_generate(
+        self, model: str, requests: List[GenerationRequest],
+        decode_host: str, decode_port: int,
+        decode_model: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> List[GenerationResult]:
+        """Disaggregated end-to-end: prefill here, decode at the peer.
+
+        ``timeout`` is the decode budget (serialized as ``peer_timeout``
+        for the prefill worker's wait on its peer); this call itself waits
+        2× that, leaving headroom for prefill + KV transfer — otherwise a
+        decode that finishes inside its allowance could still time out
+        here and falsely dent the healthy prefill worker."""
+        budget = timeout if timeout is not None else self.timeout
+        result = await self.call(
+            "prefill_generate", model=model,
+            requests=[request_to_dict(r) for r in requests],
+            decode_host=decode_host, decode_port=decode_port,
+            decode_model=decode_model or model,
+            peer_timeout=budget,
+            timeout=2.0 * budget,
         )
         return [result_from_dict(d) for d in result["results"]]
 

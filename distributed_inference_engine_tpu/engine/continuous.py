@@ -114,6 +114,11 @@ class ContinuousEngine:
 
         # ---- queues / state
         self._waiting: Deque[GenerationRequest] = collections.deque()
+        # disaggregated admissions: (request, handoff) pairs whose prefill
+        # already ran on a prefill-pool worker (engine/disagg.py)
+        self._waiting_prefilled: Deque[Tuple[GenerationRequest, Any]] = (
+            collections.deque()
+        )
         self._slots: Dict[int, _Slot] = {}
         self._finished: List[GenerationResult] = []
 
@@ -194,11 +199,100 @@ class ContinuousEngine:
         self._waiting.append(request)
         return request.request_id
 
+    def submit_prefilled(self, request: GenerationRequest, handoff: Any) -> str:
+        """Enqueue a request whose prefill ran on a prefill-pool worker.
+
+        ``handoff`` is an ``engine.disagg.PrefillHandoff``: the prompt KV
+        (``[L, T, Hkv, Dh]`` numpy, already in the cache dtype) plus the
+        first sampled token. Admission scatters the KV into paged slots and
+        decoding proceeds exactly as for a locally-prefilled sequence.
+        """
+        L, T, Hkv, Dh = handoff.k.shape
+        if (L, Hkv, Dh) != (self.spec.n_layers, self.spec.n_kv_heads,
+                            self.spec.head_dim):
+            raise ValueError(
+                f"handoff KV shape {handoff.k.shape} does not match model "
+                f"(L={self.spec.n_layers}, Hkv={self.spec.n_kv_heads}, "
+                f"Dh={self.spec.head_dim})"
+            )
+        if T != handoff.prompt_len or T < 1 or T >= self.max_seq_len:
+            raise ValueError(
+                f"handoff prompt_len {handoff.prompt_len} / KV T {T} invalid "
+                f"for max_seq_len {self.max_seq_len}"
+            )
+        self._total_requests += 1
+        if not request.request_id:
+            request.request_id = f"creq-{self._total_requests}"
+        self._waiting_prefilled.append((request, handoff))
+        return request.request_id
+
     # ---------------------------------------------------------- admission
+
+    def _admit_prefilled(self) -> int:
+        """Admit handed-off sequences: write their KV into pages, no local
+        prefill program — the disaggregated half of ``_try_admit``."""
+        admitted = 0
+        while self._waiting_prefilled:
+            req, handoff = self._waiting_prefilled[0]
+            prompt_len = handoff.prompt_len
+            slot = self.kv.alloc_slot(prompt_len)
+            if slot is None:
+                self._admission_denied += 1
+                break
+            self._waiting_prefilled.popleft()
+            admitted += 1
+            t0 = time.perf_counter()
+            # pad T to a prefill bucket so the scatter reuses the same
+            # compiled shapes as local admission
+            tb = _next_bucket(prompt_len, self.prefill_buckets)
+            L, _, Hkv, Dh = handoff.k.shape
+            ks = np.zeros((L, 1, tb, Hkv, Dh), dtype=handoff.k.dtype)
+            vs = np.zeros_like(ks)
+            ks[:, 0, :prompt_len] = handoff.k
+            vs[:, 0, :prompt_len] = handoff.v
+            seq_lens = jnp.asarray([prompt_len], jnp.int32)
+            kp, vp = write_prefill_pages(
+                self.kv.k_pages, self.kv.v_pages,
+                jnp.asarray(ks), jnp.asarray(vs),
+                self.kv.page_table[slot: slot + 1], seq_lens,
+            )
+            self.kv.swap(kp, vp)
+            self._total_prompt_tokens += prompt_len
+            self._install_slot(req, slot, prompt_len, handoff.first_token, t0)
+        return admitted
+
+    def _install_slot(self, req: GenerationRequest, slot: int,
+                      prompt_len: int, first: int, t0: float) -> None:
+        """Shared tail of admission: host bookkeeping + device slot state
+        for a sequence whose prompt KV is in pages and whose first token is
+        ``first``."""
+        state = _Slot(req, slot, prompt_len)
+        state.tokens.append(first)
+        state.produced = 1
+        state.first_token_at = time.perf_counter()
+        self._slots[slot] = state
+        self.prefill_stats.add(state.first_token_at - t0)
+
+        done = (req.eos_id >= 0 and first == req.eos_id) or \
+            req.max_new_tokens <= 1
+        if done:
+            self._finish(slot, "stop" if req.eos_id >= 0 and
+                         first == req.eos_id else "length")
+            return
+        i = slot
+        self._lengths = self._lengths.at[i].set(prompt_len)
+        self._last = self._last.at[i].set(first)
+        self._active = self._active.at[i].set(True)
+        self._produced = self._produced.at[i].set(1)
+        self._max_new = self._max_new.at[i].set(req.max_new_tokens)
+        self._eos = self._eos.at[i].set(req.eos_id)
+        self._temps = self._temps.at[i].set(req.temperature)
+        self._top_k = self._top_k.at[i].set(req.top_k)
+        self._top_p = self._top_p.at[i].set(req.top_p)
 
     def _try_admit(self) -> int:
         """Prefill waiting requests into free slots; returns #admitted."""
-        admitted = 0
+        admitted = self._admit_prefilled()
         while self._waiting:
             req = self._waiting[0]
             # overlong prompts keep their tail (sliding-window truncation,
@@ -232,31 +326,8 @@ class ContinuousEngine:
             self._rng, k0 = jax.random.split(self._rng)
             first = int(np.asarray(sample_tokens(logits, sampling, k0))[0])
 
-            state = _Slot(req, slot, len(prompt))
-            state.tokens.append(first)
-            state.produced = 1
-            state.first_token_at = time.perf_counter()
-            self._slots[slot] = state
-            self.prefill_stats.add(state.first_token_at - t0)
             self._total_prompt_tokens += len(prompt)
-
-            done = (req.eos_id >= 0 and first == req.eos_id) or \
-                req.max_new_tokens <= 1
-            if done:
-                self._finish(slot, "stop" if req.eos_id >= 0 and
-                             first == req.eos_id else "length")
-                continue
-            # install device state for the slot
-            i = slot
-            self._lengths = self._lengths.at[i].set(len(prompt))
-            self._last = self._last.at[i].set(first)
-            self._active = self._active.at[i].set(True)
-            self._produced = self._produced.at[i].set(1)
-            self._max_new = self._max_new.at[i].set(req.max_new_tokens)
-            self._eos = self._eos.at[i].set(req.eos_id)
-            self._temps = self._temps.at[i].set(req.temperature)
-            self._top_k = self._top_k.at[i].set(req.top_k)
-            self._top_p = self._top_p.at[i].set(req.top_p)
+            self._install_slot(req, slot, len(prompt), first, t0)
         return admitted
 
     # ------------------------------------------------------------- finish
@@ -347,7 +418,7 @@ class ContinuousEngine:
         """Pump until every queued request finishes; returns (and clears)
         the finished results."""
         for _ in range(max_iters):
-            if self.step() == 0 and not self._waiting:
+            if self.step() == 0 and not self.n_waiting:
                 break
         return self.drain_finished()
 
@@ -366,8 +437,10 @@ class ContinuousEngine:
         """Drop every waiting and live request (no results produced) and
         return their pages to the pool. Recovery hook for the pump when a
         decode step fails irrecoverably."""
-        n = len(self._waiting) + len(self._slots)
+        n = (len(self._waiting) + len(self._waiting_prefilled)
+             + len(self._slots))
         self._waiting.clear()
+        self._waiting_prefilled.clear()
         for slot in list(self._slots):
             self._slots.pop(slot)
             self.kv.free_slot(slot)
@@ -376,7 +449,7 @@ class ContinuousEngine:
 
     @property
     def n_waiting(self) -> int:
-        return len(self._waiting)
+        return len(self._waiting) + len(self._waiting_prefilled)
 
     @property
     def n_live(self) -> int:
@@ -389,7 +462,7 @@ class ContinuousEngine:
             "total_requests": self._total_requests,
             "total_prompt_tokens": self._total_prompt_tokens,
             "total_generated_tokens": self._total_generated,
-            "waiting": len(self._waiting),
+            "waiting": self.n_waiting,
             "live_slots": len(self._slots),
             "admission_denied": self._admission_denied,
             "capacity_finishes": self._capacity_finishes,

@@ -84,9 +84,14 @@ def engine_from_config(cfg):
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",
-              "attention_impl", "kv_dtype"):
+              "attention_impl", "kv_dtype", "prefill_buckets"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
+    if cfg.metadata.get("role") == "prefill":
+        # disaggregated prefill pool: prefill-only engine (engine/disagg.py)
+        from ..engine.disagg import PrefillEngine
+
+        return PrefillEngine(spec, params=params, config=ecfg)
     if cfg.metadata.get("continuous"):
         from ..engine.continuous import ContinuousEngine
 

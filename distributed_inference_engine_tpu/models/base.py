@@ -172,13 +172,16 @@ def _norm(spec: ModelSpec, x, scale, bias):
     return rms_norm(x, scale, spec.norm_eps)
 
 
-def _mlp(spec: ModelSpec, blk: Params, x):
+def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
     """Feed-forward block -> (out, moe_aux_loss). Dense blocks report aux 0
-    so every layer body has one static structure for lax.scan."""
+    so every layer body has one static structure for lax.scan.
+
+    ``exact_moe`` selects the drop-free MoE path (inference default);
+    training passes False to keep GShard capacity dispatch (ops/moe.py)."""
     if spec.n_experts:
         from ..ops.moe import moe_mlp
 
-        return moe_mlp(spec, blk, x)
+        return moe_mlp(spec, blk, x, exact=exact_moe)
     if spec.mlp == "swiglu":
         gate = jnp.einsum("btd,df->btf", x, blk["w_gate"])
         up = jnp.einsum("btd,df->btf", x, blk["w_up"])
@@ -258,6 +261,7 @@ def _prefill_scan(
     params: Params,
     tokens: jnp.ndarray,
     seq_lens: jnp.ndarray,
+    exact_moe: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """forward_prefill plus the summed MoE router aux loss (0 for dense)."""
     b, t = tokens.shape
@@ -270,7 +274,7 @@ def _prefill_scan(
         attn = causal_attention(q, k, v, seq_lens)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, aux = _mlp(spec, blk, h2)
+        m, aux = _mlp(spec, blk, h2, exact_moe=exact_moe)
         x = x + m
         return x, (k, v, aux)
 
@@ -441,8 +445,12 @@ def forward_train_aux(
     tokens: jnp.ndarray,     # [B, T]
     seq_lens: jnp.ndarray,   # [B]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(logits [B, T, V] fp32, summed MoE router aux loss — 0 for dense)."""
-    hidden, _, _, aux = _prefill_scan(spec, params, tokens, seq_lens)
+    """(logits [B, T, V] fp32, summed MoE router aux loss — 0 for dense).
+
+    Training path: keeps GShard capacity dispatch (drops regularize
+    routing); inference prefill/decode use the exact drop-free MoE path."""
+    hidden, _, _, aux = _prefill_scan(spec, params, tokens, seq_lens,
+                                      exact_moe=False)
     return unembed(spec, params, hidden), aux
 
 

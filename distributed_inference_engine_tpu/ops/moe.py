@@ -45,11 +45,22 @@ def moe_mlp(
     spec,                       # ModelSpec (avoid circular import)
     blk: Dict[str, Any],        # one layer's params: w_router + expert FFN
     x: jnp.ndarray,             # [B, T, D]
+    exact: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE feed-forward over a token batch.
 
-    Returns (out [B, T, D], aux_loss scalar fp32). Dropped (over-capacity)
-    tokens contribute zero here; the caller's residual stream carries them.
+    Returns (out [B, T, D], aux_loss scalar fp32).
+
+    ``exact=False`` (training): capacity-bounded GShard dispatch — tokens
+    that overflow an expert's capacity are dropped from it and ride the
+    residual. Dropping is a *training regularizer*; served generations must
+    never lose expert outputs to batch-composition luck.
+
+    ``exact=True`` (inference): every expert runs over every token and the
+    routed combine keeps only each token's top-k — no capacity, no drops,
+    bit-exact routing semantics. Costs E/K× the expert FLOPs, the right
+    trade for decode (tiny n, memory-bound: the expert weights dominate HBM
+    traffic either way) and for correctness-first prefill.
     """
     b, t, d = x.shape
     E, K = spec.n_experts, spec.experts_per_token
@@ -65,10 +76,33 @@ def moe_mlp(
     gate, idx = lax.top_k(probs, K)                            # [n, K]
     gate = gate / jnp.maximum(gate.sum(axis=-1, keepdims=True), 1e-9)
 
+    # --- Switch load-balance loss (identical for both paths)
+    assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)         # [n, K, E]
+    frac = assign.sum(axis=(0, 1)) / float(n * K)              # [E], sums to 1
+    mean_prob = probs.mean(axis=0)                             # [E]
+    aux = jnp.float32(E) * jnp.sum(frac * mean_prob)
+
+    if exact:
+        # dense-all-experts: h_e(x) for every (expert, token) pair, then a
+        # [n, E] combine keeps each token's top-k gates. Static shapes, all
+        # MXU; no dispatch tensor, no drops.
+        if spec.mlp == "swiglu":
+            g = jnp.einsum("nd,edf->enf", xf, blk["w_gate"])
+            u = jnp.einsum("nd,edf->enf", xf, blk["w_up"])
+            h = jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u
+        else:
+            u = jnp.einsum("nd,edf->enf", xf, blk["w_up"])
+            h = jax.nn.gelu(u.astype(jnp.float32), approximate=True
+                            ).astype(x.dtype)
+        out_e = jnp.einsum("enf,efd->end", h, blk["w_down"])   # [E, n, D]
+        weights = (assign * gate[..., None]).sum(axis=1)       # [n, E]
+        out = jnp.einsum("ne,end->nd", weights,
+                         out_e.astype(jnp.float32)).astype(x.dtype)
+        return out.reshape(b, t, d), aux
+
     # --- capacity assignment. GShard priority order: all tokens' choice-0
     # first, then choice-1, ... so a token's primary expert wins slots over
     # another token's backup.
-    assign = jax.nn.one_hot(idx, E, dtype=jnp.float32)         # [n, K, E]
     flat = assign.transpose(1, 0, 2).reshape(K * n, E)         # choice-major
     pos = jnp.cumsum(flat, axis=0) - flat                      # slots used before
     pos = pos.reshape(K, n, E).transpose(1, 0, 2)              # [n, K, E]
@@ -92,11 +126,6 @@ def moe_mlp(
     out = jnp.einsum(
         "nec,ecd->nd", combine, expert_out.astype(jnp.float32)
     ).astype(x.dtype)
-
-    # --- Switch load-balance loss: E * Σ_e (dispatch fraction · mean prob)
-    frac = assign.sum(axis=(0, 1)) / float(n * K)              # [E], sums to 1
-    mean_prob = probs.mean(axis=0)                             # [E]
-    aux = jnp.float32(E) * jnp.sum(frac * mean_prob)
     return out.reshape(b, t, d), aux
 
 

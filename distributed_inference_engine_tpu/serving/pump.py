@@ -42,7 +42,8 @@ class EnginePump:
         self.engine = engine
         self.idle_wait_s = idle_wait_s          # safety-net poll when idle
         self.error_backoff_s = error_backoff_s  # pause after a failed step
-        self._inbox: List[Tuple[GenerationRequest, asyncio.Future,
+        # (request, optional prefill handoff, future, caller's loop)
+        self._inbox: List[Tuple[GenerationRequest, Any, asyncio.Future,
                                 asyncio.AbstractEventLoop]] = []
         self._inbox_lock = threading.Lock()
         # pump id -> (future, loop, caller's original request id)
@@ -58,13 +59,25 @@ class EnginePump:
     async def generate(self, requests: List[GenerationRequest]
                        ) -> List[GenerationResult]:
         """Submit into the rolling batch; resolves when all finish."""
+        return await self._submit_all([(r, None) for r in requests])
+
+    async def generate_prefilled(
+        self, pairs: List[Tuple[GenerationRequest, Any]]
+    ) -> List[GenerationResult]:
+        """Disaggregated admission: (request, PrefillHandoff) pairs join the
+        rolling batch via ``engine.submit_prefilled`` — no local prefill."""
+        return await self._submit_all(pairs)
+
+    async def _submit_all(
+        self, pairs: List[Tuple[GenerationRequest, Any]]
+    ) -> List[GenerationResult]:
         self._ensure_thread()
         loop = asyncio.get_running_loop()
         futs: List[asyncio.Future] = []
         with self._inbox_lock:
-            for r in requests:
+            for r, handoff in pairs:
                 fut: asyncio.Future = loop.create_future()
-                self._inbox.append((r, fut, loop))
+                self._inbox.append((r, handoff, fut, loop))
                 futs.append(fut)
         self._wake.set()
         results = await asyncio.gather(*futs)
@@ -85,7 +98,7 @@ class EnginePump:
         exc = RuntimeError("engine pump shut down")
         with self._inbox_lock:
             pending, self._inbox = self._inbox, []
-        for _req, fut, loop in pending:
+        for _req, _handoff, fut, loop in pending:
             loop.call_soon_threadsafe(self._set_exc, fut, exc)
         self._fail_all(exc)
 
@@ -132,13 +145,16 @@ class EnginePump:
     def _drain_inbox(self) -> int:
         with self._inbox_lock:
             batch, self._inbox = self._inbox, []
-        for req, fut, loop in batch:
+        for req, handoff, fut, loop in batch:
             pump_id = f"pump-{id(self):x}-{len(self._futures)}-{time.monotonic_ns()}"
             original_id = req.request_id
             req.request_id = pump_id
             self._futures[pump_id] = (fut, loop, original_id)
             try:
-                self.engine.submit(req)
+                if handoff is not None:
+                    self.engine.submit_prefilled(req, handoff)
+                else:
+                    self.engine.submit(req)
             except Exception as e:
                 del self._futures[pump_id]
                 loop.call_soon_threadsafe(self._set_exc, fut, e)

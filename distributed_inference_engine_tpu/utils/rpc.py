@@ -20,43 +20,124 @@ logger = logging.getLogger(__name__)
 
 
 class RPCError(RuntimeError):
-    """Peer-reported request failure (distinct from transport failure)."""
+    """Peer-reported request failure (distinct from transport failure).
+
+    ``kind`` carries the peer's machine-readable error class (the
+    envelope's ``error_kind``, from the handler exception's
+    ``rpc_error_kind`` attribute) so callers can react to specific
+    failures — e.g. a relay's unreachable decode peer — without sniffing
+    error text.
+    """
+
+    def __init__(self, message: str, kind: str = "") -> None:
+        super().__init__(message)
+        self.kind = kind
 
 
 class FramedRPCClient:
-    """Persistent framed-RPC connection: one in-flight call at a time,
-    transparent reconnect after a drop, poisoned-connection teardown."""
+    """Pooled framed-RPC client: concurrent calls each ride their own
+    connection (bounded by ``max_connections``), with transparent reconnect
+    after a drop and poisoned-connection teardown.
+
+    One frame in flight per connection keeps request/response matching
+    trivial (the server answers in frame order per stream); concurrency
+    comes from the pool, so N coordinator dispatch groups to one worker —
+    or N relays holding a decode peer for a whole generation — overlap
+    instead of serializing behind a single socket lock.
+    """
 
     def __init__(self, host: str, port: int,
                  timeout: float = 30.0,
-                 max_frame: int = 64 * 1024 * 1024) -> None:
+                 max_frame: int = 64 * 1024 * 1024,
+                 max_connections: int = 8) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
         self.max_frame = max_frame
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._lock = asyncio.Lock()
+        self.max_connections = max(1, max_connections)
+        # idle connections ready for reuse; _total counts idle + in-use
+        self._free: list = []   # [(reader, writer)]
+        self._total = 0
+        self._cond = asyncio.Condition()
         self._seq = 0
+        self._closed = False
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
-    async def _ensure_connected(self) -> None:
-        if self._writer is None or self._writer.is_closing():
-            self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port
-            )
+    async def _acquire(
+        self, timeout: float
+    ) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+        async def _get() -> Tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+            async with self._cond:
+                while True:
+                    while self._free:
+                        reader, writer = self._free.pop()
+                        if writer.is_closing():    # died while idle
+                            self._total -= 1
+                            continue
+                        return reader, writer
+                    if self._total < self.max_connections:
+                        self._total += 1  # reserve before the await below
+                        break
+                    await self._cond.wait()
+            try:
+                return await asyncio.open_connection(self.host, self.port)
+            except BaseException:
+                async with self._cond:
+                    self._total -= 1
+                    self._cond.notify()
+                raise
+
+        # the timeout must bound the connect/wait too — a blackholed host
+        # otherwise hangs the OS TCP connect (~2 min)
+        return await asyncio.wait_for(_get(), timeout=timeout)
+
+    async def _release(self, conn) -> None:
+        if self._closed:
+            # close() ran while this call was in flight — don't re-pool a
+            # socket nobody will ever close again
+            self._discard_nowait(conn)
+            return
+        async with self._cond:
+            self._free.append(conn)
+            self._cond.notify()
+
+    def _discard_nowait(self, conn) -> None:
+        """Synchronous discard: safe to run from a CancelledError handler
+        (any further ``await`` there could be interrupted again, leaking
+        the slot). Counter writes are loop-thread-atomic; waiters get their
+        notify from a detached task that can't be cancelled with us."""
+        _reader, writer = conn
+        writer.close()
+        self._total -= 1
+
+        async def _notify() -> None:
+            async with self._cond:
+                self._cond.notify()
+
+        try:
+            asyncio.get_running_loop().create_task(_notify())
+        except RuntimeError:      # no running loop (teardown) — no waiters
+            pass
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        """Close idle connections and mark the pool closed: in-flight calls
+        discard their connection when they finish instead of re-pooling it,
+        so the count drains to zero. A later ``call`` reopens the pool
+        (reconnect semantics, matching the pre-pool client)."""
+        self._closed = True
+        async with self._cond:
+            free, self._free = self._free, []
+            self._total -= len(free)
+            self._cond.notify_all()
+        for _reader, writer in free:
+            writer.close()
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError):
                 pass
-            self._reader = self._writer = None
 
     async def call(self, method: str, *, timeout: Optional[float] = None,
                    **params: Any) -> Any:
@@ -69,23 +150,26 @@ class FramedRPCClient:
         self._seq += 1
         msg = {"method": method, "id": f"{id(self):x}-{self._seq}", **params}
         effective = timeout if timeout is not None else self.timeout
-        async with self._lock:  # one in-flight call per connection
-            # the timeout must bound the connect too — a blackholed host
-            # otherwise hangs the OS TCP connect (~2 min) with the lock held
-            await asyncio.wait_for(self._ensure_connected(), timeout=effective)
-            assert self._reader is not None and self._writer is not None
-            try:
-                await write_frame(self._writer, msg)
-                response = await read_frame(
-                    self._reader, max_frame=self.max_frame, timeout=effective,
-                )
-            except Exception:
-                await self.close()  # poisoned connection — drop it
-                raise
+        self._closed = False          # calling a closed client reopens it
+        conn = await self._acquire(effective)
+        try:
+            await write_frame(conn[1], msg)
+            response = await read_frame(
+                conn[0], max_frame=self.max_frame, timeout=effective,
+            )
+        except BaseException:
+            # BaseException: a cancelled caller must still return its slot
+            # (a response may be in flight on the socket — discard it), or
+            # the pool leaks towards zero capacity
+            self._discard_nowait(conn)
+            raise
+        else:
+            await self._release(conn)
         if not isinstance(response, dict):
             raise RPCError(f"malformed response: {response!r}")
         if not response.get("success"):
-            raise RPCError(response.get("error", "unknown peer error"))
+            raise RPCError(response.get("error", "unknown peer error"),
+                           kind=str(response.get("error_kind", "")))
         return response.get("result")
 
 
@@ -161,6 +245,9 @@ class FramedServerMixin:
                            type(self).__name__, method, e)
             response = {"id": req_id, "success": False, **extra,
                         "error": str(e)}
+            kind = getattr(e, "rpc_error_kind", "") or getattr(e, "kind", "")
+            if kind:
+                response["error_kind"] = kind
         self._after_dispatch(method, req_id, time.perf_counter() - t0,
                              response)
         return response

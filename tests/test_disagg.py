@@ -1,0 +1,445 @@
+"""Disaggregated prefill/decode tests (engine/disagg.py; SURVEY.md §2.3 last
+row — the reference *declared* disaggregated inference,
+``/root/reference/README.md:15,96-98``, with no code behind it).
+
+Correctness bar: a disaggregated pair must produce token-for-token the same
+greedy output as a unified engine with the same weights — the handoff carries
+exact KV state, not an approximation."""
+
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.api import Coordinator, CoordinatorConfig
+from distributed_inference_engine_tpu.config import (
+    BatcherConfig,
+    EngineConfig,
+    HealthConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import (
+    DECODE_PEER_UNREACHABLE,
+    WorkerClient,
+    WorkerRPCError,
+    WorkerServer,
+)
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.disagg import (
+    PrefillEngine,
+    PrefillHandoff,
+    handoff_from_wire,
+    handoff_to_wire,
+)
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.llama import llama_spec
+
+SPEC = llama_spec("llama-tiny", max_seq_len=128)
+
+
+def _cfg(**over):
+    base = dict(max_slots=4, max_seq_len=128, page_size=16, num_pages=64,
+                decode_steps_per_call=4, attention_impl="xla")
+    base.update(over)
+    return EngineConfig(**base)
+
+
+def _reqs():
+    return [
+        GenerationRequest(prompt=[1, 2, 3, 4, 5], max_new_tokens=8,
+                          temperature=0.0, request_id="a"),
+        GenerationRequest(prompt=[7, 8, 9], max_new_tokens=6,
+                          temperature=0.0, request_id="b"),
+    ]
+
+
+# ---------------------------------------------------------------- wire form
+
+
+def test_handoff_wire_roundtrip_bf16():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    k = rng.randn(4, 5, 4, 64).astype("float32").astype(jnp.bfloat16)
+    v = rng.randn(4, 5, 4, 64).astype("float32").astype(jnp.bfloat16)
+    h = PrefillHandoff(request_id="r1", prompt_len=5, first_token=42,
+                       k=k, v=v)
+    wire = handoff_to_wire(h)
+    assert isinstance(wire["k"], bytes)
+    back = handoff_from_wire(wire)
+    assert back.request_id == "r1" and back.first_token == 42
+    assert back.k.dtype == k.dtype and back.k.shape == k.shape
+    np.testing.assert_array_equal(np.asarray(back.k, dtype="float32"),
+                                  np.asarray(k, dtype="float32"))
+    np.testing.assert_array_equal(np.asarray(back.v, dtype="float32"),
+                                  np.asarray(v, dtype="float32"))
+
+
+# ------------------------------------------------------------- engine level
+
+
+def test_disagg_matches_unified_greedy():
+    import jax
+
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(0))
+    unified = ContinuousEngine(SPEC, params=params, config=_cfg())
+    base = {r.request_id: r.tokens for r in unified.generate(_reqs())}
+
+    pe = PrefillEngine(SPEC, params=params, config=_cfg())
+    handoffs = pe.prefill(_reqs())
+    # through the wire, as the RPC plane would carry it
+    handoffs = [handoff_from_wire(handoff_to_wire(h)) for h in handoffs]
+    de = ContinuousEngine(SPEC, params=params, config=_cfg())
+    for r, h in zip(_reqs(), handoffs):
+        de.submit_prefilled(r, h)
+    out = {r.request_id: r.tokens for r in de.run_until_idle()}
+    assert out == base
+    assert pe.get_metrics()["total_handoff_bytes"] > 0
+
+
+def test_submit_prefilled_validates_shapes():
+    de = ContinuousEngine(SPEC, config=_cfg())
+    bad = PrefillHandoff(request_id="x", prompt_len=3, first_token=1,
+                         k=np.zeros((2, 3, 4, 64), "float32"),
+                         v=np.zeros((2, 3, 4, 64), "float32"))
+    with pytest.raises(ValueError):
+        de.submit_prefilled(
+            GenerationRequest(prompt=[1, 2, 3], max_new_tokens=2), bad)
+    # prompt_len / T mismatch
+    bad2 = PrefillHandoff(
+        request_id="x", prompt_len=5, first_token=1,
+        k=np.zeros((SPEC.n_layers, 3, SPEC.n_kv_heads, SPEC.head_dim),
+                   "float32"),
+        v=np.zeros((SPEC.n_layers, 3, SPEC.n_kv_heads, SPEC.head_dim),
+                   "float32"))
+    with pytest.raises(ValueError):
+        de.submit_prefilled(
+            GenerationRequest(prompt=[1, 2, 3], max_new_tokens=2), bad2)
+
+
+# ---------------------------------------------------------------- RPC level
+
+
+def _model_cfg(role=None, continuous=False, name="m"):
+    meta = {"size": "llama-tiny", "page_size": 16, "num_pages": 64,
+            "attention_impl": "xla", "kv_dtype": "float32",
+            "decode_steps_per_call": 4}
+    if role:
+        meta["role"] = role
+    if continuous:
+        meta["continuous"] = 1
+    return ModelConfig(name=name, architecture="llama", dtype="float32",
+                       max_seq_len=64, max_batch_size=4, metadata=meta)
+
+
+@pytest.mark.asyncio
+async def test_worker_rpc_prefill_then_decode():
+    """prefill on one worker, generate_prefilled on another — results match
+    a unified continuous worker with the same (seed-0) weights."""
+    wp = WorkerServer(ServerConfig(worker_id="wp", port=0))
+    wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
+    wu = WorkerServer(ServerConfig(worker_id="wu", port=0))
+    await wp.start()
+    await wd.start()
+    await wu.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        await wd.load_model_async(_model_cfg(continuous=True))
+        await wu.load_model_async(_model_cfg(continuous=True))
+
+        cp = WorkerClient(*wp.address, timeout=120.0)
+        cd = WorkerClient(*wd.address, timeout=120.0)
+        cu = WorkerClient(*wu.address, timeout=120.0)
+
+        base = await cu.generate("m", _reqs())
+        handoffs = await cp.prefill("m", _reqs())
+        out = await cd.generate_prefilled("m", _reqs(), handoffs)
+        assert {r.request_id: r.tokens for r in out} == \
+            {r.request_id: r.tokens for r in base}
+
+        # role errors are informative
+        with pytest.raises(WorkerRPCError, match="does not support"):
+            await cd.prefill("m", _reqs())
+        with pytest.raises(WorkerRPCError, match="does not support"):
+            await cp.generate("m", _reqs())
+        await cp.close()
+        await cd.close()
+        await cu.close()
+    finally:
+        await wp.stop()
+        await wd.stop()
+        await wu.stop()
+
+
+@pytest.mark.asyncio
+async def test_worker_rpc_prefill_generate_relay():
+    """The single-KV-hop path: coordinator-side caller talks only to the
+    prefill worker; KV goes prefill → decode peer directly."""
+    wp = WorkerServer(ServerConfig(worker_id="wp", port=0))
+    wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
+    await wp.start()
+    await wd.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        await wd.load_model_async(_model_cfg(continuous=True))
+        cp = WorkerClient(*wp.address, timeout=120.0)
+        dhost, dport = wd.address
+        out = await cp.prefill_generate("m", _reqs(), dhost, dport,
+                                        timeout=120.0)
+        assert sorted(r.request_id for r in out) == ["a", "b"]
+        for r in out:
+            assert len(r.tokens) >= 1
+        # decode-side engine actually did the decoding
+        dm = wd.get_metrics()["models"]["m"]
+        assert dm["total_requests"] == 2
+        assert dm["total_generated_tokens"] > 0
+        # prefill-side engine never decoded
+        pm = wp.get_metrics()["models"]["m"]
+        assert pm["role"] == "prefill"
+        await cp.close()
+    finally:
+        await wp.stop()
+        await wd.stop()
+
+
+# ------------------------------------------------------------- coordinator
+
+
+@pytest.mark.asyncio
+async def test_coordinator_disaggregated_end_to_end():
+    coord = Coordinator(CoordinatorConfig(
+        batcher=BatcherConfig(max_batch_size=4, max_latency_ms=10.0),
+        health=HealthConfig(check_interval=0.2, check_timeout=1.0,
+                            max_consecutive_failures=2),
+    ))
+    await coord.start()
+    workers = []
+    try:
+        for i in range(4):
+            w = WorkerServer(ServerConfig(worker_id=f"w{i}", port=0))
+            host, port = await w.start()
+            workers.append(w)
+            coord.add_worker(f"w{i}", host, port)
+        np_, nd = await coord.deploy_model_disaggregated(
+            _model_cfg(), ["w0", "w1"], ["w2", "w3"])
+        assert (np_, nd) == (2, 2)
+
+        outs = [await coord.submit("m", prompt=[1, 2, 3, 4 + i],
+                                   max_new_tokens=5, key=f"k{i}")
+                for i in range(4)]
+        for out in outs:
+            assert len(out["tokens"]) == 5
+            assert out["metadata"]["prefill_worker"] in ("w0", "w1")
+            assert out["metadata"]["decode_worker"] in ("w2", "w3")
+        # both prefill workers rotated
+        used_prefill = {o["metadata"]["prefill_worker"] for o in outs}
+        assert used_prefill == {"w0", "w1"}
+        stats = coord.get_stats()
+        assert stats["disaggregated"]["m"]["decode"] == ["w2", "w3"]
+
+        # pool validation
+        with pytest.raises(ValueError, match="both pools"):
+            await coord.deploy_model_disaggregated(_model_cfg(name="x"),
+                                                   [], ["w2"])
+        with pytest.raises(ValueError, match="both pools"):
+            await coord.deploy_model_disaggregated(_model_cfg(name="x"),
+                                                   ["w0"], [])
+        with pytest.raises(ValueError, match="overlap|both pools|in both"):
+            await coord.deploy_model_disaggregated(_model_cfg(name="x"),
+                                                   ["w0"], ["w0"])
+    finally:
+        await coord.stop()
+        for w in workers:
+            await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_relay_packs_handoffs_across_frames():
+    """Handoffs bigger than one frame must split into several
+    generate_prefilled calls, not die on the frame limit (review finding:
+    a long prompt's oversize frame was misread as a dead decode peer)."""
+    # budget = max_frame - 1MiB headroom; the two llama-tiny handoffs here
+    # are ~24KB and ~16KB, so a ~30KB budget forces one call per request
+    wp = WorkerServer(ServerConfig(worker_id="wp", port=0,
+                                   max_frame_bytes=1_078_576))
+    wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
+    wu = WorkerServer(ServerConfig(worker_id="wu", port=0))
+    await wp.start()
+    await wd.start()
+    await wu.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        await wd.load_model_async(_model_cfg(continuous=True))
+        await wu.load_model_async(_model_cfg(continuous=True))
+        cp = WorkerClient(*wp.address, timeout=120.0)
+        cu = WorkerClient(*wu.address, timeout=120.0)
+        base = await cu.generate("m", _reqs())
+        out = await cp.prefill_generate("m", _reqs(), *wd.address,
+                                        timeout=120.0)
+        assert {r.request_id: r.tokens for r in out} == \
+            {r.request_id: r.tokens for r in base}
+        # one relay arrived as TWO generate_prefilled calls on the peer
+        assert wd._request_count == 2
+        await cp.close()
+        await cu.close()
+    finally:
+        await wp.stop()
+        await wd.stop()
+        await wu.stop()
+
+
+@pytest.mark.asyncio
+async def test_relay_oversize_single_handoff_is_config_error():
+    """A single handoff that can't fit any frame is an application error
+    naming the knob — NOT a decode-peer failure that dents health."""
+    wp = WorkerServer(ServerConfig(worker_id="wp", port=0,
+                                   max_frame_bytes=1_058_576))
+    wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
+    await wp.start()
+    await wd.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        await wd.load_model_async(_model_cfg(continuous=True))
+        cp = WorkerClient(*wp.address, timeout=120.0)
+        with pytest.raises(WorkerRPCError, match="max_frame_bytes") as ei:
+            await cp.prefill_generate("m", _reqs(), *wd.address,
+                                      timeout=60.0)
+        assert ei.value.kind != DECODE_PEER_UNREACHABLE
+        await cp.close()
+    finally:
+        await wp.stop()
+        await wd.stop()
+
+
+@pytest.mark.asyncio
+async def test_load_model_feature_superset_is_directional():
+    """A continuous preload accepts a plain (static) deploy — superset —
+    but a static preload rejects a continuous (decode-pool) deploy."""
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        await w.load_model_async(_model_cfg(continuous=True))
+        # plain deploy needs only {generate}: idempotent accept
+        await w.load_model_async(_model_cfg(continuous=False))
+        assert "m" in w.engines
+    finally:
+        await w.stop()
+
+    w2 = WorkerServer(ServerConfig(worker_id="w2", port=0))
+    await w2.start()
+    try:
+        await w2.load_model_async(_model_cfg(continuous=False))
+        with pytest.raises(ValueError, match="unload it first"):
+            await w2.load_model_async(_model_cfg(continuous=True))
+    finally:
+        await w2.stop()
+
+
+@pytest.mark.asyncio
+async def test_decode_peer_down_reports_error_kind():
+    """A dead decode peer must surface as a machine-readable error kind,
+    not an anonymous app error (review finding: the coordinator could not
+    distinguish decode-peer-down from a bad request)."""
+    wp = WorkerServer(ServerConfig(worker_id="wp", port=0))
+    await wp.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        cp = WorkerClient(*wp.address, timeout=60.0)
+        with pytest.raises(WorkerRPCError) as ei:
+            await cp.prefill_generate("m", _reqs(), "127.0.0.1", 1,
+                                      timeout=30.0)
+        assert ei.value.kind == DECODE_PEER_UNREACHABLE
+        await cp.close()
+    finally:
+        await wp.stop()
+
+
+@pytest.mark.asyncio
+async def test_load_model_role_mismatch_rejected():
+    """Same model identity but a different capability (prefill vs generate)
+    must error, not pass the idempotency check (review finding: a
+    wrong-role preload blackholed the pool)."""
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        await w.load_model_async(_model_cfg(role="prefill"))
+        with pytest.raises(ValueError, match="unload it first"):
+            await w.load_model_async(_model_cfg(continuous=True))
+    finally:
+        await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_coordinator_disagg_decode_failover():
+    """Killing a decode worker mid-deployment: the relay reports the peer
+    down, the coordinator marks the DECODE worker and retries on the
+    surviving decode shard."""
+    coord = Coordinator(CoordinatorConfig(
+        batcher=BatcherConfig(max_batch_size=2, max_latency_ms=5.0),
+        health=HealthConfig(check_interval=30.0, check_timeout=0.5,
+                            max_consecutive_failures=1),
+    ))
+    await coord.start()
+    workers = []
+    try:
+        for i in range(3):
+            w = WorkerServer(ServerConfig(worker_id=f"w{i}", port=0))
+            host, port = await w.start()
+            workers.append(w)
+            coord.add_worker(f"w{i}", host, port)
+        await coord.deploy_model_disaggregated(
+            _model_cfg(), ["w0"], ["w1", "w2"])
+        await workers[1].stop()   # kill decode worker w1
+
+        # every request completes on the surviving decode shard, whatever
+        # shard its key hashes to (health.check_interval is long: only the
+        # error-kind path can mask the dead worker this fast)
+        for i in range(4):
+            out = await coord.submit("m", prompt=[1, 2, 3 + i],
+                                     max_new_tokens=3, key=f"k{i}",
+                                     no_cache=True)
+            assert len(out["tokens"]) == 3
+            assert out["metadata"]["decode_worker"] == "w2"
+    finally:
+        await coord.stop()
+        for w in (workers[0], workers[2]):
+            await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_coordinator_disagg_prefill_failover():
+    """Killing one prefill worker reroutes new requests to the survivor
+    (prefill is stateless — SURVEY.md §7 hard-part #5 doesn't bite here)."""
+    coord = Coordinator(CoordinatorConfig(
+        batcher=BatcherConfig(max_batch_size=2, max_latency_ms=5.0),
+        health=HealthConfig(check_interval=0.2, check_timeout=0.5,
+                            max_consecutive_failures=1),
+    ))
+    await coord.start()
+    workers = []
+    try:
+        for i in range(3):
+            w = WorkerServer(ServerConfig(worker_id=f"w{i}", port=0))
+            host, port = await w.start()
+            workers.append(w)
+            coord.add_worker(f"w{i}", host, port)
+        await coord.deploy_model_disaggregated(
+            _model_cfg(), ["w0", "w1"], ["w2"])
+        out = await coord.submit("m", prompt=[1, 2, 3], max_new_tokens=3,
+                                 key="warm")
+        assert len(out["tokens"]) == 3
+
+        await workers[0].stop()   # kill prefill worker w0
+        # the retry path masks the dead worker immediately; every request
+        # still completes
+        for i in range(3):
+            out = await coord.submit("m", prompt=[2, 3, 4 + i],
+                                     max_new_tokens=3, key=f"f{i}",
+                                     no_cache=True)
+            assert len(out["tokens"]) == 3
+            assert out["metadata"]["prefill_worker"] == "w1"
+    finally:
+        await coord.stop()
+        for w in workers[1:]:
+            await w.stop()

@@ -103,8 +103,70 @@ def test_moe_capacity_overflow_drops_but_stays_finite():
     )
     params = init_params(tight, jax.random.key(1))
     toks, lens = _tokens(tight, b=2, t=32, seed=3)
-    logits = forward_train(tight, params, toks, lens)
+    # the TRAINING path keeps capacity dropping (a regularizer)
+    logits, _aux = forward_train_aux(tight, params, toks, lens)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_moe_exact_never_drops_under_skew():
+    """Inference must not lose expert outputs to batch-composition luck:
+    with every token routed to ONE expert (identical inputs) and capacity
+    far below the batch, the capacity path zeroes overflow tokens while the
+    exact path treats all tokens identically (review finding: capacity
+    dropping corrupted served generations)."""
+    spec = MOE_SPEC
+    params = init_params(spec, jax.random.key(5))
+    blk = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+    # 32 identical tokens -> identical routing -> one expert gets them all
+    x = jnp.broadcast_to(jax.random.normal(jax.random.key(6), (1, 1, spec.d_model)),
+                         (1, 32, spec.d_model)).astype(jnp.float32)
+
+    out_exact, aux_e = moe_mlp(spec, blk, x, exact=True)
+    out_cap, aux_c = moe_mlp(spec, blk, x, exact=False)
+    out_exact, out_cap = np.asarray(out_exact), np.asarray(out_cap)
+
+    # exact: every (identical) token gets the same, non-zero output
+    assert np.abs(out_exact).max() > 0
+    assert np.abs(out_exact[0] - out_exact[0, :1]).max() == 0.0
+    # capacity path: C = ceil(32*2/4 * 1.25) = 20 slots < 32 tokens -> the
+    # overflow tokens' rows are exactly zero (dropped)
+    zero_rows = np.all(out_cap[0] == 0.0, axis=-1).sum()
+    assert zero_rows > 0, "capacity path should drop under this skew"
+    # aux loss identical across paths (same routing)
+    np.testing.assert_allclose(float(aux_e), float(aux_c), rtol=1e-6)
+
+
+def test_moe_decode_matches_prefill_logits():
+    """Paged decode (exact MoE) must agree with the exact prefill forward:
+    generate one token greedily from a prompt and check it equals the
+    argmax of the prefill logits at the last position."""
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+    from distributed_inference_engine_tpu.engine.types import GenerationRequest
+    from distributed_inference_engine_tpu.models.base import forward_train
+
+    # paged layout needs n_kv_heads*head_dim % 128 == 0
+    spec = mixtral_spec(
+        "mixtral-tiny", dtype="float32", max_seq_len=64,
+        n_layers=2, d_model=128, n_heads=2, n_kv_heads=2, d_ff=96,
+        vocab_size=128, n_experts=4, experts_per_token=2,
+    )
+    params = init_params(spec, jax.random.key(7))
+    prompt = [3, 1, 4, 1, 5]
+    toks = jnp.asarray([prompt], jnp.int32)
+    lens = jnp.asarray([len(prompt)], jnp.int32)
+    logits = forward_train(spec, params, toks, lens)
+    expect_first = int(np.asarray(logits)[0, len(prompt) - 1].argmax())
+
+    eng = ContinuousEngine(spec, params=params, config=EngineConfig(
+        max_slots=2, max_seq_len=32, page_size=8, num_pages=16,
+        attention_impl="xla", kv_dtype="float32", decode_steps_per_call=2,
+    ))
+    out = eng.generate([GenerationRequest(prompt=prompt, max_new_tokens=3,
+                                          temperature=0.0)])
+    assert out[0].tokens[0] == expect_first
 
 
 def test_moe_router_gets_gradient():

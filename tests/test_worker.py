@@ -148,10 +148,51 @@ async def test_client_reconnects_after_drop():
     server, client = await start_worker()
     try:
         await client.ping()
-        # forcibly kill the client's transport, then call again
-        client._writer.close()
+        # forcibly kill every pooled transport, then call again
+        for _reader, writer in client._free:
+            writer.close()
         pong = await client.ping()
         assert pong["worker_id"] == "w0"
     finally:
         await client.close()
         await server.stop()
+
+
+async def test_client_pool_overlaps_concurrent_calls():
+    """One client, concurrent calls: the connection pool must let slow
+    calls overlap instead of serializing behind a single socket (review
+    finding: a relay holding a connection for a whole decode blocked every
+    other dispatch to that worker)."""
+    import time as _time
+
+    from distributed_inference_engine_tpu.utils.rpc import (
+        FramedRPCClient,
+        FramedServerMixin,
+    )
+
+    class SlowServer(FramedServerMixin):
+        def __init__(self):
+            self._conn_writers = set()
+            self._methods = {"slow": self._slow}
+
+        async def _slow(self, msg):
+            await asyncio.sleep(0.4)
+            return {"ok": True}
+
+    srv = SlowServer()
+    server = await asyncio.start_server(srv._handle_connection,
+                                        "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    client = FramedRPCClient("127.0.0.1", port, timeout=10.0)
+    try:
+        t0 = _time.perf_counter()
+        outs = await asyncio.gather(*(client.call("slow") for _ in range(4)))
+        elapsed = _time.perf_counter() - t0
+        assert all(o["ok"] for o in outs)
+        # serialized would take >= 1.6s; pooled should be ~0.4s
+        assert elapsed < 1.2, f"calls serialized: {elapsed:.2f}s"
+        assert client._total <= client.max_connections
+    finally:
+        await client.close()
+        server.close()
+        await server.wait_closed()
