@@ -520,7 +520,15 @@ class Coordinator:
         """
         pwid = self._pick_prefill_worker(pool)
         pclient = self.router.client_for(pwid)
-        dinfo = self.router.workers[decode_wid]
+        dinfo = self.router.workers.get(decode_wid)
+        if dinfo is None:
+            # stale shard (worker removed between routing and dispatch):
+            # same error class as a dead peer, so the retry path moves the
+            # group to an alternate decode shard
+            raise WorkerRPCError(
+                f"decode worker {decode_wid!r} is no longer registered",
+                kind=DECODE_PEER_UNREACHABLE,
+            )
         self.lb.acquire(pwid)
         t0 = time.perf_counter()
         try:

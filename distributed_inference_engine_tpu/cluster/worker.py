@@ -458,7 +458,10 @@ class WorkerServer(FramedServerMixin):
         # one), never a DecodePeerError: misclassifying it would dent the
         # healthy decode worker's health on every long prompt.
         wires = [handoff_to_wire(h) for h in handoffs]
-        budget = self.config.max_frame_bytes - 1_048_576  # envelope headroom
+        # envelope headroom of 1 MiB, but never below half the frame for
+        # small configured limits (budget must stay usable, not negative)
+        budget = max(self.config.max_frame_bytes - 1_048_576,
+                     self.config.max_frame_bytes // 2)
         sizes = [len(w["k"]) + len(w["v"]) + 4096 for w in wires]
         for h, s in zip(handoffs, sizes):
             if s > budget:
@@ -467,13 +470,17 @@ class WorkerServer(FramedServerMixin):
                     f"exceeds the {self.config.max_frame_bytes}-byte frame "
                     "limit; raise ServerConfig.max_frame_bytes on both pools"
                 )
-        batches: List[Tuple[List[int], int]] = []   # (indices, bytes)
+        batches: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
         for i, s in enumerate(sizes):
-            if batches and batches[-1][1] + s <= budget:
-                batches[-1][0].append(i)
-                batches = [*batches[:-1], (batches[-1][0], batches[-1][1] + s)]
-            else:
-                batches.append(([i], s))
+            if cur and cur_bytes + s > budget:
+                batches.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += s
+        if cur:
+            batches.append(cur)
 
         # peer_timeout travels IN the message (the client-side ``timeout``
         # kwarg only bounds the caller's own read and is never serialized);
@@ -490,17 +497,26 @@ class WorkerServer(FramedServerMixin):
                 timeout=peer_timeout,
             )
 
+        tasks = [asyncio.ensure_future(_send(idxs)) for idxs in batches]
         try:
-            parts = await asyncio.gather(*(_send(idxs)
-                                           for idxs, _ in batches))
-        except (OSError, ConnectionError, asyncio.TimeoutError,
-                asyncio.IncompleteReadError, EOFError, FrameError) as e:
-            raise DecodePeerError(
-                f"decode peer {host}:{port} unreachable: "
-                f"{type(e).__name__}: {e}"
-            ) from e
+            parts = await asyncio.gather(*tasks)
+        except BaseException as e:
+            # one sub-batch failing must CANCEL the siblings — the caller
+            # will re-dispatch the whole group elsewhere, and an orphaned
+            # sub-batch would keep burning decode slots for discarded output
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if isinstance(e, (OSError, ConnectionError, asyncio.TimeoutError,
+                              asyncio.IncompleteReadError, EOFError,
+                              FrameError)):
+                raise DecodePeerError(
+                    f"decode peer {host}:{port} unreachable: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            raise
         results: List[Any] = [None] * len(reqs_wire)
-        for (idxs, _), part in zip(batches, parts):
+        for idxs, part in zip(batches, parts):
             for i, r in zip(idxs, part["results"]):
                 results[i] = r
         return {"model": name, "results": results,

@@ -81,6 +81,19 @@ def engine_from_config(cfg):
         params = load_checkpoint(cfg.path, spec)
     else:
         params = None
+    if cfg.quantized:
+        # weight-only int8 (ops/quant.py): the registry's `quantized` flag,
+        # made real — halves decode's HBM weight traffic
+        import jax as _jax
+
+        from ..ops.quant import quantize_params
+
+        if params is None:
+            from .base import init_params
+
+            params = init_params(spec, _jax.random.key(
+                int(cfg.metadata.get("seed", 0))))
+        params = quantize_params(spec, params)
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",

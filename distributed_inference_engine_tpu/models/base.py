@@ -38,6 +38,7 @@ from jax import lax
 
 from ..ops.attention import cached_attention, causal_attention
 from ..ops.norms import layer_norm, rms_norm
+from ..ops.quant import QuantizedTensor, matmul_any
 from ..ops.rope import apply_rope
 
 Params = Dict[str, Any]
@@ -183,15 +184,15 @@ def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
 
         return moe_mlp(spec, blk, x, exact=exact_moe)
     if spec.mlp == "swiglu":
-        gate = jnp.einsum("btd,df->btf", x, blk["w_gate"])
-        up = jnp.einsum("btd,df->btf", x, blk["w_up"])
+        gate = matmul_any("btd,df->btf", x, blk["w_gate"])
+        up = matmul_any("btd,df->btf", x, blk["w_up"])
         h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
     else:
-        h = jnp.einsum("btd,df->btf", x, blk["w_up"])
+        h = matmul_any("btd,df->btf", x, blk["w_up"])
         if spec.use_bias:
             h = h + blk["b_up"]
         h = jax.nn.gelu(h.astype(jnp.float32), approximate=True).astype(x.dtype)
-    out = jnp.einsum("btf,fd->btd", h, blk["w_down"])
+    out = matmul_any("btf,fd->btd", h, blk["w_down"])
     if spec.use_bias:
         out = out + blk["b_down"]
     return out, jnp.float32(0.0)
@@ -200,9 +201,9 @@ def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
 def _qkv(spec: ModelSpec, blk: Params, x, positions):
     b, t, _ = x.shape
     H, Hkv, Dh = spec.n_heads, spec.n_kv_heads, spec.head_dim
-    q = jnp.einsum("btd,de->bte", x, blk["wq"])
-    k = jnp.einsum("btd,de->bte", x, blk["wk"])
-    v = jnp.einsum("btd,de->bte", x, blk["wv"])
+    q = matmul_any("btd,de->bte", x, blk["wq"])
+    k = matmul_any("btd,de->bte", x, blk["wk"])
+    v = matmul_any("btd,de->bte", x, blk["wv"])
     if spec.use_bias:
         q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
     q = q.reshape(b, t, H, Dh)
@@ -216,7 +217,7 @@ def _qkv(spec: ModelSpec, blk: Params, x, positions):
 
 def _out_proj(spec: ModelSpec, blk: Params, attn_out):
     b, t, h, dh = attn_out.shape
-    out = jnp.einsum("bte,ed->btd", attn_out.reshape(b, t, h * dh), blk["wo"])
+    out = matmul_any("bte,ed->btd", attn_out.reshape(b, t, h * dh), blk["wo"])
     if spec.use_bias:
         out = out + blk["bo"]
     return out
@@ -235,7 +236,10 @@ def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray
     """Final norm + LM head. hidden [..., D] -> fp32 logits [..., V]."""
     h = _norm(spec, hidden, params["lnf_scale"], params.get("lnf_bias"))
     w = params["tok_emb"].T if spec.tie_embeddings else params["lm_head"]
-    return jnp.einsum("...d,dv->...v", h.astype(jnp.float32), w.astype(jnp.float32))
+    if isinstance(w, QuantizedTensor):
+        return matmul_any("...d,dv->...v", h.astype(jnp.float32), w)
+    return jnp.einsum("...d,dv->...v", h.astype(jnp.float32),
+                      w.astype(jnp.float32))
 
 
 # ------------------------------------------------------------------ prefill

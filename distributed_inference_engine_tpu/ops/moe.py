@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quant import matmul_any
+
 
 def moe_capacity(n_tokens: int, n_experts: int, experts_per_token: int,
                  capacity_factor: float) -> int:
@@ -85,16 +87,17 @@ def moe_mlp(
     if exact:
         # dense-all-experts: h_e(x) for every (expert, token) pair, then a
         # [n, E] combine keeps each token's top-k gates. Static shapes, all
-        # MXU; no dispatch tensor, no drops.
+        # MXU; no dispatch tensor, no drops. matmul_any: expert weights may
+        # be int8-quantized for serving (ops/quant.py).
         if spec.mlp == "swiglu":
-            g = jnp.einsum("nd,edf->enf", xf, blk["w_gate"])
-            u = jnp.einsum("nd,edf->enf", xf, blk["w_up"])
+            g = matmul_any("nd,edf->enf", xf, blk["w_gate"])
+            u = matmul_any("nd,edf->enf", xf, blk["w_up"])
             h = jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u
         else:
-            u = jnp.einsum("nd,edf->enf", xf, blk["w_up"])
+            u = matmul_any("nd,edf->enf", xf, blk["w_up"])
             h = jax.nn.gelu(u.astype(jnp.float32), approximate=True
                             ).astype(x.dtype)
-        out_e = jnp.einsum("enf,efd->end", h, blk["w_down"])   # [E, n, D]
+        out_e = matmul_any("enf,efd->end", h, blk["w_down"])   # [E, n, D]
         weights = (assign * gate[..., None]).sum(axis=1)       # [n, E]
         out = jnp.einsum("ne,end->nd", weights,
                          out_e.astype(jnp.float32)).astype(x.dtype)

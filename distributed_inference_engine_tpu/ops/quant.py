@@ -1,0 +1,145 @@
+"""Weight-only int8 quantization for the inference matmuls.
+
+Realises the ``quantized`` flag the reference carries as dead metadata
+(``/root/reference/src/model_registry.py:55`` stores it, nothing reads it):
+here it halves the weight bytes every decode step streams from HBM — the
+binding resource of the memory-bound decode loop (SURVEY.md §7; TPU decode
+throughput ≈ HBM bandwidth / bytes-per-step).
+
+Scheme: symmetric per-output-channel int8.
+
+- For a weight ``w`` contracted over its input axes, ``scale =
+  max|w| / 127`` per output channel and ``q = round(w / scale)``.
+- Dequantisation happens INSIDE the matmul: ``y = einsum(x, q.astype(bf16))
+  * scale`` — XLA fuses the convert into the MXU feed, so only int8 bytes
+  cross HBM; the per-channel scale applies to the matmul *output* (cheap:
+  O(tokens·channels), not O(weights)).
+- Activations, norms, biases, embeddings and the KV cache stay in the
+  compute dtype — this is weight-only quantisation (the standard serving
+  trade: no activation-quant error, all the bandwidth win).
+
+``QuantizedTensor`` is a pytree, so quantized params flow through
+``lax.scan`` over stacked layer blocks unchanged: the scan slices ``q`` and
+``s`` along the layer axis together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """int8 weight + broadcastable per-channel scales (dequant = q * s)."""
+
+    q: jnp.ndarray   # int8, same shape as the original weight
+    s: jnp.ndarray   # float32; shape = weight shape with input axes size 1
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size * 1 + self.s.size * self.s.dtype.itemsize
+
+    def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+
+
+def quantize_weight(w: jnp.ndarray,
+                    reduce_axes: Sequence[int]) -> QuantizedTensor:
+    """Symmetric int8 over ``reduce_axes`` (the matmul's contraction axes;
+    remaining axes are output/batch channels, one scale each)."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(reduce_axes), keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, s=scale)
+
+
+def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``einsum`` that accepts a plain array or a ``QuantizedTensor``.
+
+    For a quantized weight the int8 payload is cast to the activation dtype
+    at the MXU feed and the per-output-channel scale multiplies the result
+    — valid because the scale is constant over every contracted axis.
+    """
+    if isinstance(w, QuantizedTensor):
+        y = jnp.einsum(pattern, x, w.q.astype(x.dtype))
+        return y * _out_scale(w.s).astype(y.dtype)
+    return jnp.einsum(pattern, x, w)
+
+
+def _out_scale(s: jnp.ndarray) -> jnp.ndarray:
+    """Reshape the keepdims scale so it broadcasts against the einsum
+    output: drop the contracted (size-1) LEADING axes.
+
+    Works for every pattern this codebase uses because output channels of
+    the weight are always its TRAILING axes (``de->...e``;
+    MoE ``edf->e·f`` keeps its interior singleton, which broadcasts over
+    the token axis of the ``[E, n, F]`` result).
+    """
+    out = s
+    while out.ndim > 0 and out.shape[0] == 1:
+        out = out[0]
+    return out
+
+
+# --------------------------------------------------------------- param tree
+
+# blocks-tree weights: name -> contraction axes within ONE layer's slice
+# (the stored arrays carry a leading [L] layer axis, so +1 on each when
+# quantizing the stacked tree). Dense slices are [D_in, D_out].
+_BLOCK_WEIGHTS: Dict[str, Tuple[int, ...]] = {
+    "wq": (0,), "wk": (0,), "wv": (0,), "wo": (0,),
+    "w_up": (0,), "w_gate": (0,), "w_down": (0,),
+}
+# MoE expert slices are [E, D_in, D_out] (w_up/w_gate: [E, D, F];
+# w_down: [E, F, D]) — contraction is always slice axis 1
+_MOE_WEIGHTS: Dict[str, Tuple[int, ...]] = {
+    "w_up": (1,), "w_gate": (1,), "w_down": (1,),
+}
+
+
+def quantize_params(spec, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize the big matmul weights of a loaded/initialised param tree.
+
+    Kept full-precision: embeddings (gather, not matmul), norms, biases,
+    the MoE router (tiny and precision-sensitive), and a tied LM head
+    (shares storage with ``tok_emb``).
+    """
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    moe = bool(getattr(spec, "n_experts", 0))
+    for name, axes in _BLOCK_WEIGHTS.items():
+        w = blocks.get(name)
+        if w is None or isinstance(w, QuantizedTensor):
+            continue
+        if moe and name in _MOE_WEIGHTS:
+            axes = _MOE_WEIGHTS[name]
+        blocks[name] = quantize_weight(w, [a + 1 for a in axes])
+    out["blocks"] = blocks
+    if not spec.tie_embeddings and "lm_head" in out:
+        out["lm_head"] = quantize_weight(out["lm_head"], (0,))
+    return out
+
+
+def param_bytes(params: Any) -> int:
+    """Total stored bytes of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -94,24 +94,32 @@ class FramedRPCClient:
         # otherwise hangs the OS TCP connect (~2 min)
         return await asyncio.wait_for(_get(), timeout=timeout)
 
-    async def _release(self, conn) -> None:
+    def _release_nowait(self, conn) -> None:
+        """Synchronous re-pool: no ``await`` means no suspension point at
+        which a cancelled caller could leak the slot (the same discipline
+        as ``_discard_nowait``). List mutation is loop-thread-atomic;
+        waiters are notified by a detached task."""
         if self._closed:
             # close() ran while this call was in flight — don't re-pool a
             # socket nobody will ever close again
             self._discard_nowait(conn)
             return
-        async with self._cond:
-            self._free.append(conn)
-            self._cond.notify()
+        self._free.append(conn)
+        self._notify_detached()
 
     def _discard_nowait(self, conn) -> None:
         """Synchronous discard: safe to run from a CancelledError handler
         (any further ``await`` there could be interrupted again, leaking
-        the slot). Counter writes are loop-thread-atomic; waiters get their
-        notify from a detached task that can't be cancelled with us."""
+        the slot)."""
         _reader, writer = conn
         writer.close()
         self._total -= 1
+        self._notify_detached()
+
+    def _notify_detached(self) -> None:
+        """Wake one _acquire waiter from a task that can't be cancelled
+        with the caller (Condition.notify needs the lock, which needs an
+        await)."""
 
         async def _notify() -> None:
             async with self._cond:
@@ -164,7 +172,7 @@ class FramedRPCClient:
             self._discard_nowait(conn)
             raise
         else:
-            await self._release(conn)
+            self._release_nowait(conn)
         if not isinstance(response, dict):
             raise RPCError(f"malformed response: {response!r}")
         if not response.get("success"):

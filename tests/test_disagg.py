@@ -259,10 +259,11 @@ async def test_relay_packs_handoffs_across_frames():
     """Handoffs bigger than one frame must split into several
     generate_prefilled calls, not die on the frame limit (review finding:
     a long prompt's oversize frame was misread as a dead decode peer)."""
-    # budget = max_frame - 1MiB headroom; the two llama-tiny handoffs here
-    # are ~24KB and ~16KB, so a ~30KB budget forces one call per request
+    # budget = max(max_frame - 1MiB, max_frame/2); the two llama-tiny
+    # handoffs are ~24KB and ~16KB, so a 30KB budget (60KB frames) forces
+    # one call per request
     wp = WorkerServer(ServerConfig(worker_id="wp", port=0,
-                                   max_frame_bytes=1_078_576))
+                                   max_frame_bytes=60_000))
     wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
     wu = WorkerServer(ServerConfig(worker_id="wu", port=0))
     await wp.start()
@@ -294,7 +295,7 @@ async def test_relay_oversize_single_handoff_is_config_error():
     """A single handoff that can't fit any frame is an application error
     naming the knob — NOT a decode-peer failure that dents health."""
     wp = WorkerServer(ServerConfig(worker_id="wp", port=0,
-                                   max_frame_bytes=1_058_576))
+                                   max_frame_bytes=20_000))
     wd = WorkerServer(ServerConfig(worker_id="wd", port=0))
     await wp.start()
     await wd.start()

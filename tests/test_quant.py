@@ -1,0 +1,127 @@
+"""Weight-only int8 quantization tests (ops/quant.py): the reference
+stores a ``quantized`` flag it never reads
+(``/root/reference/src/model_registry.py:55``); here it must actually
+shrink weight bytes while keeping generations materially unchanged."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_engine_tpu.config import EngineConfig, ModelConfig
+from distributed_inference_engine_tpu.engine.engine import Engine
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models import engine_from_config
+from distributed_inference_engine_tpu.models.base import (
+    forward_train,
+    init_params,
+)
+from distributed_inference_engine_tpu.models.llama import (
+    llama_spec,
+    mixtral_spec,
+)
+from distributed_inference_engine_tpu.ops.quant import (
+    QuantizedTensor,
+    matmul_any,
+    param_bytes,
+    quantize_params,
+    quantize_weight,
+)
+
+SPEC = llama_spec("llama-tiny", max_seq_len=64, dtype="float32")
+
+
+def test_quantize_weight_roundtrip_error_bounded():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(64, 32).astype("float32"))
+    qt = quantize_weight(w, (0,))
+    assert qt.q.dtype == jnp.int8
+    assert qt.s.shape == (1, 32)
+    err = np.abs(np.asarray(qt.dequantize()) - np.asarray(w))
+    # per-channel max error <= scale/2 (round-to-nearest)
+    assert (err <= np.asarray(qt.s) / 2 + 1e-7).all()
+
+
+def test_matmul_any_matches_dequantized_einsum():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, 7, 64).astype("float32"))
+    w = jnp.asarray(rs.randn(64, 32).astype("float32"))
+    qt = quantize_weight(w, (0,))
+    got = matmul_any("btd,de->bte", x, qt)
+    want = jnp.einsum("btd,de->bte", x, qt.dequantize())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_quantized_params_shrink_and_logits_agree():
+    params = init_params(SPEC, jax.random.key(0))
+    qparams = quantize_params(SPEC, params)
+    # the big matmul weights got int8 payloads
+    assert isinstance(qparams["blocks"]["wq"], QuantizedTensor)
+    assert isinstance(qparams["blocks"]["w_down"], QuantizedTensor)
+    assert param_bytes(qparams) < 0.45 * param_bytes(params)
+
+    rs = np.random.RandomState(2)
+    toks = jnp.asarray(rs.randint(0, SPEC.vocab_size, (2, 12)), jnp.int32)
+    lens = jnp.full((2,), 12, jnp.int32)
+    full = np.asarray(forward_train(SPEC, params, toks, lens))
+    quant = np.asarray(forward_train(SPEC, qparams, toks, lens))
+    assert np.isfinite(quant).all()
+    # top-1 agreement across positions: int8 weight-only should rarely
+    # flip the argmax of a random-init model's logits
+    agree = (full.argmax(-1) == quant.argmax(-1)).mean()
+    assert agree > 0.9, f"top-1 agreement {agree:.2f}"
+    # logits stay close in relative terms
+    denom = np.abs(full).max()
+    assert np.abs(full - quant).max() / denom < 0.1
+
+
+def test_quantized_engine_generates_like_full():
+    params = init_params(SPEC, jax.random.key(0))
+    cfg = EngineConfig(max_slots=2, max_seq_len=64)
+    full_eng = Engine(SPEC, params=params, config=cfg)
+    q_eng = Engine(SPEC, params=quantize_params(SPEC, params), config=cfg)
+    reqs = [GenerationRequest(prompt=[5, 6, 7, 8], max_new_tokens=8,
+                              temperature=0.0)]
+    full_out = full_eng.generate([GenerationRequest(
+        prompt=[5, 6, 7, 8], max_new_tokens=8, temperature=0.0)])[0].tokens
+    q_out = q_eng.generate(reqs)[0].tokens
+    assert len(q_out) == 8
+    assert all(0 <= t < SPEC.vocab_size for t in q_out)
+    # greedy chains can diverge after a flip, but the first token — a pure
+    # function of the prefill logits — should match on a random-init model
+    assert q_out[0] == full_out[0]
+
+
+def test_engine_from_config_quantized_flag():
+    cfg = ModelConfig(
+        name="q", architecture="llama", dtype="float32", quantized=True,
+        max_seq_len=64, max_batch_size=2, metadata={"size": "llama-tiny"},
+    )
+    eng = engine_from_config(cfg)
+    assert isinstance(eng.params["blocks"]["wq"], QuantizedTensor)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                          max_new_tokens=4)])
+    assert len(out[0].tokens) == 4
+
+
+def test_quantized_moe_exact_path_runs():
+    spec = mixtral_spec(
+        "mixtral-tiny", dtype="float32", max_seq_len=64,
+        n_layers=2, d_model=64, n_heads=8, n_kv_heads=4, d_ff=96,
+        vocab_size=128, n_experts=4, experts_per_token=2,
+    )
+    params = init_params(spec, jax.random.key(3))
+    qparams = quantize_params(spec, params)
+    assert isinstance(qparams["blocks"]["w_up"], QuantizedTensor)
+    assert qparams["blocks"]["w_up"].s.shape == (2, 4, 1, 96)
+    # router stays full precision (tiny + precision-sensitive)
+    assert not isinstance(qparams["blocks"]["w_router"], QuantizedTensor)
+
+    rs = np.random.RandomState(4)
+    toks = jnp.asarray(rs.randint(0, spec.vocab_size, (1, 8)), jnp.int32)
+    lens = jnp.full((1,), 8, jnp.int32)
+    full = np.asarray(forward_train(spec, params, toks, lens))
+    quant = np.asarray(forward_train(spec, qparams, toks, lens))
+    assert np.isfinite(quant).all()
+    agree = (full.argmax(-1) == quant.argmax(-1)).mean()
+    assert agree > 0.8, f"top-1 agreement {agree:.2f}"
