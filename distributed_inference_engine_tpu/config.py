@@ -95,6 +95,7 @@ class EngineConfig:
     decode_steps_per_call: int = 8     # tokens generated per jit dispatch (lax.scan)
     use_paged_kv: bool = False
     attention_impl: str = "auto"       # "auto" | "xla" | "pallas"
+    prefix_cache: bool = True          # reuse full KV pages across shared prompt prefixes
 
 
 @dataclass

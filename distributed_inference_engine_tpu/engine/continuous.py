@@ -42,6 +42,7 @@ from ..models.base import (
     Params,
     forward_decode_paged,
     forward_prefill,
+    forward_prefill_suffix,
     init_params,
     unembed,
     write_prefill_pages,
@@ -111,6 +112,9 @@ class ContinuousEngine:
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.attn_impl = impl
+        self.prefix_cache = bool(cfg.prefix_cache)
+        self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
+        self._prefix_hit_admissions = 0
 
         # ---- queues / state
         self._waiting: Deque[GenerationRequest] = collections.deque()
@@ -141,6 +145,27 @@ class ContinuousEngine:
         def _prefill(params, tokens, seq_lens):
             hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
             last = hidden[jnp.arange(tokens.shape[0]), seq_lens - 1]
+            return unembed(spec_, params, last), ks, vs
+
+        page_size = self.kv.page_size
+
+        @partial(jax.jit, static_argnames=("n_ctx_pages",))
+        def _prefill_suffix(params, tokens, suffix_lens, n_ctx, phys_pages,
+                            k_pages, v_pages, n_ctx_pages: int):
+            """Prefix-cache hit: prefill only the suffix, attending over
+            the cached prefix gathered from its pages. One compiled program
+            per (suffix bucket, ctx-pages bucket) pair."""
+            L = spec_.n_layers
+            Hkv, Dh = spec_.n_kv_heads, spec_.head_dim
+            tc = n_ctx_pages * page_size
+            ck = k_pages[:, phys_pages].reshape(L, 1, tc, Hkv, Dh)
+            cv = v_pages[:, phys_pages].reshape(L, 1, tc, Hkv, Dh)
+            ck = ck.astype(spec_.jnp_dtype)
+            cv = cv.astype(spec_.jnp_dtype)
+            hidden, ks, vs = forward_prefill_suffix(
+                spec_, params, tokens, suffix_lens, n_ctx, ck, cv
+            )
+            last = hidden[jnp.arange(tokens.shape[0]), suffix_lens - 1]
             return unembed(spec_, params, last), ks, vs
 
         fwd = partial(forward_decode_paged, attn_impl=self.attn_impl)
@@ -175,6 +200,7 @@ class ContinuousEngine:
             return carry, toks
 
         self._prefill = _prefill
+        self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
 
         # ---- metrics
@@ -298,26 +324,38 @@ class ContinuousEngine:
             # overlong prompts keep their tail (sliding-window truncation,
             # same policy as Engine.generate); cap leaves ≥1 decode position
             prompt = req.prompt[-(self.max_seq_len - 1):]
-            # reserve the prompt plus at least one decode page of headroom
-            slot = self.kv.alloc_slot(len(prompt))
-            if slot is None:
-                self._admission_denied += 1
-                break
+            if self.prefix_cache:
+                got = self.kv.alloc_slot_prefix(prompt)
+                if got is None:
+                    self._admission_denied += 1
+                    break
+                slot, n_cached = got
+            else:
+                slot = self.kv.alloc_slot(len(prompt))
+                n_cached = 0
+                if slot is None:
+                    self._admission_denied += 1
+                    break
             self._waiting.popleft()
             admitted += 1
             t0 = time.perf_counter()
-            tb = _next_bucket(len(prompt), self.prefill_buckets)
-            tokens = np.zeros((1, tb), np.int32)
-            tokens[0, : len(prompt)] = prompt
-            seq_lens = jnp.asarray([len(prompt)], jnp.int32)
-            logits, ks, vs = self._prefill(
-                self.params, jnp.asarray(tokens), seq_lens
-            )
-            kp, vp = write_prefill_pages(
-                self.kv.k_pages, self.kv.v_pages, ks, vs,
-                self.kv.page_table[slot: slot + 1], seq_lens,
-            )
-            self.kv.swap(kp, vp)
+            if n_cached > 0:
+                logits = self._prefill_cached_suffix(prompt, slot, n_cached)
+            else:
+                tb = _next_bucket(len(prompt), self.prefill_buckets)
+                tokens = np.zeros((1, tb), np.int32)
+                tokens[0, : len(prompt)] = prompt
+                seq_lens = jnp.asarray([len(prompt)], jnp.int32)
+                logits, ks, vs = self._prefill(
+                    self.params, jnp.asarray(tokens), seq_lens
+                )
+                kp, vp = write_prefill_pages(
+                    self.kv.k_pages, self.kv.v_pages, ks, vs,
+                    self.kv.page_table[slot: slot + 1], seq_lens,
+                )
+                self.kv.swap(kp, vp)
+            if self.prefix_cache:
+                self.kv.register_prefix(slot, prompt)
             sampling = SamplingParams(
                 jnp.asarray([req.temperature], jnp.float32),
                 jnp.asarray([req.top_k], jnp.int32),
@@ -329,6 +367,34 @@ class ContinuousEngine:
             self._total_prompt_tokens += len(prompt)
             self._install_slot(req, slot, len(prompt), first, t0)
         return admitted
+
+    def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int):
+        """Prefix-cache-hit admission: run the jitted suffix prefill over
+        the uncached tail, write its KV at offset ``n_cached``, return the
+        last-position logits. ``n_cached`` is a whole number of pages and
+        < len(prompt) (``PagedKVCache.alloc_slot_prefix``)."""
+        suffix = prompt[n_cached:]
+        tb = _next_bucket(len(suffix), self.prefill_buckets)
+        tokens = np.zeros((1, tb), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        suffix_lens = jnp.asarray([len(suffix)], jnp.int32)
+        n_ctx = jnp.asarray([n_cached], jnp.int32)
+        ctx_pages = n_cached // self.kv.page_size
+        mpb = _next_bucket(ctx_pages, self._ctx_page_buckets)
+        phys = jnp.asarray(
+            np.ascontiguousarray(self.kv._table[slot, :mpb]), jnp.int32
+        )
+        self._prefix_hit_admissions += 1
+        logits, ks, vs = self._prefill_suffix(
+            self.params, jnp.asarray(tokens), suffix_lens, n_ctx, phys,
+            self.kv.k_pages, self.kv.v_pages, n_ctx_pages=mpb,
+        )
+        kp, vp = write_prefill_pages(
+            self.kv.k_pages, self.kv.v_pages, ks, vs,
+            self.kv.page_table[slot: slot + 1], suffix_lens, start=n_ctx,
+        )
+        self.kv.swap(kp, vp)
+        return logits
 
     # ------------------------------------------------------------- finish
 
@@ -467,6 +533,7 @@ class ContinuousEngine:
             "admission_denied": self._admission_denied,
             "capacity_finishes": self._capacity_finishes,
             "engine_steps": self._steps,
+            "prefix_hit_admissions": self._prefix_hit_admissions,
             "prefill": self.prefill_stats.snapshot(),
             "decode_chunk": self.chunk_stats.snapshot(),
             "kv": self.kv.get_stats(),

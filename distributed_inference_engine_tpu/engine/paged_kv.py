@@ -27,7 +27,9 @@ boundaries crossed mid-chunk already have physical pages behind them.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import collections
+import hashlib
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -78,23 +80,86 @@ class PagedKVCache:
         self._table_dev: Optional[jnp.ndarray] = None
         self._peak_pages_used = 0
 
+        # ---- prefix cache (vLLM-style shared full pages; SURVEY.md §3.5's
+        # kvstore north-star taken one level deeper: the unit of reuse is a
+        # KV page keyed by its token-prefix hash, not a whole response)
+        self._page_ref: Dict[int, int] = {}            # live page -> refcount
+        self._prefix_index: Dict[bytes, int] = {}      # chain hash -> page
+        self._page_key: Dict[int, bytes] = {}          # page -> chain hash
+        # registered pages with refcount 0: reusable immediately on a hash
+        # hit, reclaimable (oldest first) when the free list runs dry
+        self._reclaimable: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_hits_pages = 0
+        self._prefix_hits_tokens = 0
+        self._prefix_queries = 0
+        self._prefix_reclaimed = 0
+
+    # ------------------------------------------------------- page sourcing
+
+    @property
+    def available_pages(self) -> int:
+        """Pages obtainable right now: free + reclaimable cached."""
+        return len(self._free) + len(self._reclaimable)
+
+    def _take_free(self, n: int) -> Optional[List[int]]:
+        """Source ``n`` writable pages (each returned with refcount 1):
+        free list first, then reclaim the oldest cached-but-unreferenced
+        prefix pages (evicting their index entries)."""
+        if n <= 0:
+            return []
+        if self.available_pages < n:
+            return None
+        out: List[int] = []
+        while len(out) < n and self._free:
+            out.append(self._free.pop(0))
+        while len(out) < n:
+            page, _ = self._reclaimable.popitem(last=False)   # oldest
+            key = self._page_key.pop(page)
+            self._prefix_index.pop(key, None)
+            self._prefix_reclaimed += 1
+            out.append(page)
+        for p in out:
+            self._page_ref[p] = 1
+        used = self.num_pages - len(self._free) - len(self._reclaimable)
+        self._peak_pages_used = max(self._peak_pages_used, used)
+        return out
+
+    def _unref(self, page: int) -> None:
+        self._page_ref[page] -= 1
+        if self._page_ref[page] > 0:
+            return
+        del self._page_ref[page]
+        if page in self._page_key:
+            # registered prefix page: stays warm for future hash hits,
+            # reclaimed LRU-last when the pool needs writable pages
+            self._reclaimable[page] = None
+            self._reclaimable.move_to_end(page)
+        else:
+            self._free.append(page)
+
     # ------------------------------------------------------------ slots
 
     def alloc_slot(self, n_tokens: int) -> Optional[int]:
         """Claim a slot with capacity for ``n_tokens``; None if no slot or
         not enough pages (caller queues the request)."""
-        need = self._pages_for(n_tokens)
-        if not self._free_slots or len(self._free) < need:
+        if not self._free_slots:
             return None
+        pages = self._take_free(self._pages_for(n_tokens))
+        if pages is None:
+            return None
+        return self._install_slot_pages(pages, n_tokens)
+
+    def _install_slot_pages(self, pages: List[int], n_tokens: int) -> int:
+        """Shared tail of slot allocation: claim a slot id and point its
+        table row at ``pages`` (each already refcounted by the caller)."""
         slot = self._free_slots.pop(0)
-        pages = [self._free.pop(0) for _ in range(need)]
         self._slot_pages[slot] = pages
         self._slot_len[slot] = n_tokens
         self._table[slot, : len(pages)] = pages
         self._table[slot, len(pages):] = 0
         self._table_dirty = True
-        used = self.num_pages - len(self._free)
-        self._peak_pages_used = max(self._peak_pages_used, used)
         return slot
 
     def reserve(self, slot: int, n_tokens: int) -> int:
@@ -115,16 +180,14 @@ class PagedKVCache:
         if need <= 0:
             self._slot_len[slot] = total
             return granted
-        if len(self._free) < need:
+        pages = self._take_free(need)
+        if pages is None:
             return 0
-        pages = [self._free.pop(0) for _ in range(need)]
         cur = self._slot_pages[slot]
         self._table[slot, len(cur): len(cur) + len(pages)] = pages
         cur.extend(pages)
         self._slot_len[slot] = total
         self._table_dirty = True
-        used = self.num_pages - len(self._free)
-        self._peak_pages_used = max(self._peak_pages_used, used)
         return granted
 
     def ensure_capacity(self, slot: int, total_tokens: int) -> int:
@@ -140,14 +203,13 @@ class PagedKVCache:
         target = min(total_tokens, self.max_seq_len)
         pages = self._slot_pages[slot]
         need = self._pages_for(target) - len(pages)
-        take = min(max(need, 0), len(self._free))
+        take = min(max(need, 0), self.available_pages)
         if take > 0:
-            fresh = [self._free.pop(0) for _ in range(take)]
+            fresh = self._take_free(take)
+            assert fresh is not None
             self._table[slot, len(pages): len(pages) + take] = fresh
             pages.extend(fresh)
             self._table_dirty = True
-            used = self.num_pages - len(self._free)
-            self._peak_pages_used = max(self._peak_pages_used, used)
         cap = min(len(pages) * self.page_size, self.max_seq_len)
         self._slot_len[slot] = max(self._slot_len[slot], min(target, cap))
         return cap
@@ -156,7 +218,8 @@ class PagedKVCache:
         pages = self._slot_pages.pop(slot, None)
         if pages is None:
             return
-        self._free.extend(pages)
+        for p in pages:
+            self._unref(p)
         del self._slot_len[slot]
         self._free_slots.append(slot)
         self._table[slot, :] = 0
@@ -164,6 +227,86 @@ class PagedKVCache:
 
     def _pages_for(self, n_tokens: int) -> int:
         return max(1, -(-n_tokens // self.page_size))
+
+    # ----------------------------------------------------- prefix caching
+
+    def _page_hashes(self, tokens, n_pages: int) -> List[bytes]:
+        """Chain hashes for the first ``n_pages`` FULL pages of ``tokens``:
+        hash_i commits to tokens[0 : (i+1)·P], so a hit is an exact-prefix
+        match, never a content collision across different prefixes."""
+        out: List[bytes] = []
+        h = b""
+        P = self.page_size
+        for i in range(n_pages):
+            chunk = np.asarray(tokens[i * P: (i + 1) * P], np.int64).tobytes()
+            h = hashlib.blake2b(h + chunk, digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def alloc_slot_prefix(self, tokens) -> Optional[Tuple[int, int]]:
+        """Claim a slot for a prompt, reusing cached KV pages for its
+        longest indexed full-page prefix. Returns (slot, n_cached_tokens),
+        or None when slots/pages are exhausted.
+
+        At most ``len(tokens) - 1`` tokens come from cache: the engine
+        always needs ≥1 suffix position to produce the first-token logits.
+        Shared pages are read-only by construction — decode writes land at
+        positions ≥ the prompt length, past every full prefix page.
+        """
+        if not self._free_slots:
+            return None
+        n_tokens = len(tokens)
+        self._prefix_queries += 1
+        matchable = (n_tokens - 1) // self.page_size
+        hashes = self._page_hashes(tokens, matchable)
+        shared: List[int] = []
+        for h in hashes:
+            page = self._prefix_index.get(h)
+            if page is None:
+                break
+            shared.append(page)
+        # PIN the shared pages BEFORE sourcing fresh ones: a ref-0 cached
+        # page sits in _reclaimable, and an unpinned _take_free under pool
+        # pressure could reclaim one of THESE pages as this slot's own
+        # writable suffix page — same physical page twice in the table, and
+        # the suffix prefill would clobber the cached prefix KV
+        for p in shared:
+            self._page_ref[p] = self._page_ref.get(p, 0) + 1
+            self._reclaimable.pop(p, None)       # in use again
+        fresh = self._take_free(self._pages_for(n_tokens) - len(shared))
+        if fresh is None:
+            for p in shared:                     # roll the pins back
+                self._unref(p)
+            return None
+        slot = self._install_slot_pages(shared + fresh, n_tokens)
+        n_cached = len(shared) * self.page_size
+        self._prefix_hits_pages += len(shared)
+        self._prefix_hits_tokens += n_cached
+        return slot, n_cached
+
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Index this slot's full prompt pages for future reuse; returns
+        how many pages were newly registered. Call after the prompt KV is
+        in the pages (post-prefill). Pages covering decode positions (the
+        partial tail) are never registered."""
+        pages = self._slot_pages.get(slot)
+        if pages is None:
+            raise KeyError(f"slot {slot} not live")
+        n_full = len(tokens) // self.page_size
+        hashes = self._page_hashes(tokens, n_full)
+        fresh = 0
+        for i, h in enumerate(hashes):
+            if h in self._prefix_index:
+                continue
+            page = pages[i]
+            if page in self._page_key:
+                # page already indexed under a different hash (shouldn't
+                # happen: shared pages match the same chain) — skip
+                continue
+            self._prefix_index[h] = page
+            self._page_key[page] = h
+            fresh += 1
+        return fresh
 
     # ----------------------------------------------------------- device
 
@@ -197,16 +340,22 @@ class PagedKVCache:
 
     def get_stats(self) -> Dict[str, float]:
         bytes_total = 2 * self.k_pages.size * self.k_pages.dtype.itemsize
-        used = self.num_pages - len(self._free)
+        used = self.num_pages - len(self._free) - len(self._reclaimable)
         return {
             "num_pages": self.num_pages,
             "page_size": self.page_size,
             "pages_used": used,
             "pages_free": len(self._free),
+            "pages_cached": len(self._reclaimable),
             "peak_pages_used": self._peak_pages_used,
             "utilization": used / self.num_pages if self.num_pages else 0.0,
             "live_slots": len(self._slot_pages),
             "free_slots": len(self._free_slots),
+            "prefix_queries": self._prefix_queries,
+            "prefix_hit_pages": self._prefix_hits_pages,
+            "prefix_hit_tokens": self._prefix_hits_tokens,
+            "prefix_reclaimed": self._prefix_reclaimed,
+            "prefix_indexed": len(self._prefix_index),
             "hbm_bytes": bytes_total,
             "hbm_gib": bytes_total / (1 << 30),
         }

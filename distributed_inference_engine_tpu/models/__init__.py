@@ -97,7 +97,8 @@ def engine_from_config(cfg):
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",
-              "attention_impl", "kv_dtype", "prefill_buckets"):
+              "attention_impl", "kv_dtype", "prefill_buckets",
+              "prefix_cache"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
     if cfg.metadata.get("role") == "prefill":

@@ -36,7 +36,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import cached_attention, causal_attention
+from ..ops.attention import (
+    cached_attention,
+    causal_attention,
+    suffix_attention,
+)
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.quant import QuantizedTensor, matmul_any
 from ..ops.rope import apply_rope
@@ -286,6 +290,41 @@ def _prefill_scan(
     return x, ks, vs, auxs.sum()
 
 
+def forward_prefill_suffix(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,      # [B, Ts] right-padded prompt SUFFIX
+    suffix_lens: jnp.ndarray, # [B] valid suffix lengths
+    n_ctx: jnp.ndarray,       # [B] cached-prefix length per row
+    k_ctx: jnp.ndarray,       # [L, B, Tc, Hkv, Dh] cached prefix K (padded)
+    v_ctx: jnp.ndarray,       # [L, B, Tc, Hkv, Dh]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill a prompt suffix on top of cached prefix KV (prefix-cache
+    hit): suffix positions are offset by ``n_ctx`` (RoPE/learned-pos see
+    absolute positions) and attention runs over cached-context + causal
+    suffix (``ops/attention.suffix_attention``).
+
+    Returns (hidden [B, Ts, D], suffix K [L, B, Ts, Hkv, Dh], suffix V).
+    """
+    b, ts = tokens.shape
+    positions = n_ctx[:, None] + jnp.arange(ts)[None, :]
+    x = embed(spec, params, tokens, positions)
+
+    def body(x, per_layer):
+        blk, ck, cv = per_layer
+        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+        q, k, v = _qkv(spec, blk, h, positions)
+        attn = suffix_attention(q, ck, cv, n_ctx, k, v, suffix_lens)
+        x = x + _out_proj(spec, blk, attn)
+        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+        m, _ = _mlp(spec, blk, h2)
+        x = x + m
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_ctx, v_ctx))
+    return x, ks, vs
+
+
 # ------------------------------------------------------------------- decode
 
 
@@ -396,17 +435,22 @@ def write_prefill_pages(
     ks: jnp.ndarray,          # [L, B, T, Hkv, Dh] fresh prefill K/V
     vs: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, MP]
-    seq_lens: jnp.ndarray,    # [B] valid prompt lengths
+    seq_lens: jnp.ndarray,    # [B] valid token count in ks/vs rows
+    start: Optional[jnp.ndarray] = None,  # [B] absolute position of token 0
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter prefilled K/V into page pools. Per layer this is ONE flat
     scatter: each valid token's (physical page, offset) flattens to an index
     into the pool viewed as [num_pages * page_size, fused]; padded positions
-    get an out-of-range index and ``mode="drop"`` discards them."""
+    get an out-of-range index and ``mode="drop"`` discards them.
+
+    ``start`` shifts the write window for suffix prefill on a prefix-cache
+    hit: row b's token t lands at absolute position start[b] + t."""
     L, B, T, Hkv, Dh = ks.shape
     page_size = k_pages.shape[2]
     fused = Hkv * Dh
-    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))        # [B, T]
-    valid = pos < seq_lens[:, None]
+    local = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))      # [B, T]
+    valid = local < seq_lens[:, None]
+    pos = local if start is None else local + start[:, None]
     logical = pos // page_size
     offset = pos % page_size
     phys = jnp.take_along_axis(

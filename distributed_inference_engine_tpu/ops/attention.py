@@ -60,6 +60,44 @@ def causal_attention(
     return out.reshape(b, t, h, dh)
 
 
+def suffix_attention(
+    q: jnp.ndarray,            # [B, Ts, H, Dh] suffix queries
+    k_ctx: jnp.ndarray,        # [B, Tc, Hkv, Dh] cached-context keys (padded)
+    v_ctx: jnp.ndarray,        # [B, Tc, Hkv, Dh]
+    n_ctx: jnp.ndarray,        # [B] valid context length per row
+    k_suf: jnp.ndarray,        # [B, Ts, Hkv, Dh] fresh suffix keys
+    v_suf: jnp.ndarray,        # [B, Ts, Hkv, Dh]
+    suffix_lens: jnp.ndarray,  # [B] valid suffix length per row
+) -> jnp.ndarray:
+    """Prefill of a prompt SUFFIX against cached prefix KV (prefix cache
+    hit, ``engine/paged_kv.py``): suffix query i (absolute position
+    n_ctx+i) attends to every valid context key and causally within the
+    suffix. Returns [B, Ts, H, Dh]."""
+    b, ts, h, dh = q.shape
+    tc = k_ctx.shape[1]
+    n_kv = k_ctx.shape[2]
+    qg = _group_query(q, n_kv)                                   # [B,Ts,Hkv,G,Dh]
+    scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+    k_all = jnp.concatenate([k_ctx, k_suf], axis=1)              # [B,Tc+Ts,...]
+    v_all = jnp.concatenate([v_ctx, v_suf], axis=1)
+    scores = jnp.einsum("bikgd,bjkd->bkgij", qg, k_all).astype(jnp.float32) * scale
+    i = jnp.arange(ts)[:, None]                                  # query idx
+    j = jnp.arange(tc + ts)[None, :]                             # key idx
+    # context keys: valid iff j < n_ctx; suffix keys: causal AND < suffix_len
+    in_ctx = (j < tc)
+    suf_j = j - tc                                               # suffix-local key idx
+    causal = suf_j <= i                                          # [Ts, Tc+Ts]
+    mask_ctx = in_ctx & (j < n_ctx[:, None, None])               # [B,1,Tc+Ts] w/ i broadcast
+    mask_suf = (~in_ctx) & causal[None, :, :] & \
+        (suf_j[None, :, :] < suffix_lens[:, None, None])
+    mask = mask_ctx | mask_suf                                   # [B, Ts, Tc+Ts]
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgij,bjkd->bikgd", probs.astype(v_all.dtype), v_all)
+    return out.reshape(b, ts, h, dh)
+
+
 def cached_attention(
     q: jnp.ndarray,          # [B, 1, H, Dh] decode queries
     cache_k: jnp.ndarray,    # [B, S, Hkv, Dh] full HBM cache rows

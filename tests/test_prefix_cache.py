@@ -1,0 +1,185 @@
+"""Prefix KV-cache tests (engine/paged_kv.py + forward_prefill_suffix):
+shared prompt prefixes must reuse KV pages — the reference's response cache
+(``src/kvstore.py``) taken to its north-star depth, where the unit of reuse
+is an attention-state page rather than a finished response.
+
+Correctness bar: prefix-cache hits must be token-for-token invisible — the
+cached KV is exact state, so greedy outputs match a cache-off engine."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import EngineConfig
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.paged_kv import PagedKVCache
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.base import init_params
+from distributed_inference_engine_tpu.models.llama import llama_spec
+
+SPEC = llama_spec("llama-tiny", max_seq_len=128)
+PAGE = 8
+SYS = list(range(1, 25))          # 24 tokens = 3 full pages of shared prefix
+
+
+def _cfg(prefix_cache=True, num_pages=64, **over):
+    base = dict(max_slots=4, max_seq_len=128, page_size=PAGE,
+                num_pages=num_pages, decode_steps_per_call=4,
+                attention_impl="xla", prefix_cache=prefix_cache)
+    base.update(over)
+    return EngineConfig(**base)
+
+
+def _reqs():
+    return [
+        GenerationRequest(prompt=SYS + [30, 31], max_new_tokens=6,
+                          temperature=0.0, request_id="a"),
+        GenerationRequest(prompt=SYS + [40, 41, 42], max_new_tokens=6,
+                          temperature=0.0, request_id="b"),
+    ]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(SPEC, jax.random.key(0))
+
+
+def test_prefix_hits_match_cache_off_engine(params):
+    off = ContinuousEngine(SPEC, params=params, config=_cfg(False))
+    base = {r.request_id: r.tokens for r in off.generate(_reqs())}
+
+    on = ContinuousEngine(SPEC, params=params, config=_cfg(True))
+    out = {r.request_id: r.tokens for r in on.generate(_reqs())}
+    assert out == base
+    m = on.get_metrics()
+    assert m["prefix_hit_admissions"] == 1          # b reused a's pages
+    assert m["kv"]["prefix_hit_tokens"] == len(SYS)
+
+    # freed slots keep their full pages warm: a fresh request with the same
+    # system prefix hits again
+    out2 = {r.request_id: r.tokens for r in on.generate(_reqs())}
+    assert out2 == base
+    assert on.get_metrics()["kv"]["prefix_hit_tokens"] >= 3 * len(SYS)
+
+
+def test_prefix_cache_partial_match(params):
+    """A prompt sharing only the first page reuses exactly that page."""
+    on = ContinuousEngine(SPEC, params=params, config=_cfg(True))
+    on.generate([GenerationRequest(prompt=SYS + [30], max_new_tokens=2,
+                                   temperature=0.0)])
+    half = SYS[:PAGE] + [90, 91, 92]               # shares one full page
+    off = ContinuousEngine(SPEC, params=params, config=_cfg(False))
+    want = off.generate([GenerationRequest(prompt=half, max_new_tokens=5,
+                                           temperature=0.0)])[0].tokens
+    got = on.generate([GenerationRequest(prompt=half, max_new_tokens=5,
+                                         temperature=0.0)])[0].tokens
+    assert got == want
+    assert on.get_metrics()["kv"]["prefix_hit_pages"] == 1
+
+
+def test_prefix_cache_never_caches_whole_prompt(params):
+    """A prompt that IS a cached prefix still prefills ≥1 suffix token
+    (the engine needs last-position logits)."""
+    on = ContinuousEngine(SPEC, params=params, config=_cfg(True))
+    p = SYS[:16]                                   # exactly 2 pages
+    on.generate([GenerationRequest(prompt=p, max_new_tokens=2,
+                                   temperature=0.0)])
+    off = ContinuousEngine(SPEC, params=params, config=_cfg(False))
+    want = off.generate([GenerationRequest(prompt=p, max_new_tokens=3,
+                                           temperature=0.0)])[0].tokens
+    got = on.generate([GenerationRequest(prompt=p, max_new_tokens=3,
+                                         temperature=0.0)])[0].tokens
+    assert got == want
+    # matched at most (16-1)//8 = 1 page on the second pass
+    assert on.get_metrics()["kv"]["prefix_hit_pages"] <= 1
+
+
+def test_reclaim_evicts_cached_pages_when_pool_is_tight():
+    """Cached pages are reclaimed LRU when the free list runs dry —
+    allocation must not fail while reclaimable pages exist."""
+    kv = PagedKVCache(SPEC, max_slots=4, page_size=PAGE, num_pages=6,
+                      max_seq_len=128, dtype="float32")
+    s1, n1 = kv.alloc_slot_prefix(list(range(100, 124)))   # 3 pages
+    assert n1 == 0
+    kv.register_prefix(s1, list(range(100, 124)))
+    kv.free_slot(s1)
+    st = kv.get_stats()
+    assert st["pages_cached"] == 3 and st["pages_free"] == 3
+
+    # a 5-page prompt needs more than the free list: reclaims 2 cached
+    s2, n2 = kv.alloc_slot_prefix(list(range(200, 240)))
+    assert s2 is not None and n2 == 0
+    st = kv.get_stats()
+    assert st["prefix_reclaimed"] == 2
+    # the reclaimed pages left the index
+    assert st["prefix_indexed"] == 1
+
+
+def test_shared_pages_refcounted_not_double_freed():
+    kv = PagedKVCache(SPEC, max_slots=4, page_size=PAGE, num_pages=16,
+                      max_seq_len=128, dtype="float32")
+    prompt = list(range(50, 75))                    # 25 tokens → 4 pages
+    s1, _ = kv.alloc_slot_prefix(prompt)
+    kv.register_prefix(s1, prompt)
+    s2, n2 = kv.alloc_slot_prefix(prompt)
+    assert n2 == 24                                 # 3 full pages shared
+    shared = kv._slot_pages[s1][:3]
+    assert kv._slot_pages[s2][:3] == shared
+    kv.free_slot(s1)
+    # shared pages still referenced by s2: not free, not reclaimable
+    for p in shared:
+        assert p not in kv._free
+        assert p not in kv._reclaimable
+    kv.free_slot(s2)
+    for p in shared:
+        assert p in kv._reclaimable                 # now cached, ref 0
+
+
+def test_shared_pages_never_reclaimed_into_own_slot():
+    """Regression (review finding): re-admitting a cached prompt under
+    full pool pressure must NOT reclaim one of its own shared prefix pages
+    as the writable suffix page — that aliases the page table and the
+    suffix prefill would clobber cached prefix KV."""
+    kv = PagedKVCache(SPEC, max_slots=4, page_size=PAGE, num_pages=4,
+                      max_seq_len=128, dtype="float32")
+    prompt = list(range(300, 332))                  # 32 tokens = 4 pages
+    s1, n1 = kv.alloc_slot_prefix(prompt)
+    assert n1 == 0
+    kv.register_prefix(s1, prompt)
+    kv.free_slot(s1)
+    assert kv.get_stats()["pages_cached"] == 4 and not kv._free
+
+    s2, n2 = kv.alloc_slot_prefix(prompt)
+    assert s2 is not None
+    pages = kv._slot_pages[s2]
+    assert len(set(pages)) == len(pages), f"aliased page table: {pages}"
+    # 3 shared pages matched; the 4th (writable) page must be the one
+    # reclaimed from cache, not any of the shared three
+    assert n2 == 24
+    assert pages[3] not in pages[:3]
+
+
+def test_alloc_prefix_rolls_back_pins_on_failure():
+    """If fresh pages can't be sourced, the shared-page pins must be
+    undone (no refcount leak)."""
+    kv = PagedKVCache(SPEC, max_slots=4, page_size=PAGE, num_pages=3,
+                      max_seq_len=128, dtype="float32")
+    p1 = list(range(400, 424))                      # 3 pages, fills pool
+    s1, _ = kv.alloc_slot_prefix(p1)
+    kv.register_prefix(s1, p1)
+    # pool exhausted (s1 holds everything): a long prompt sharing the
+    # prefix cannot allocate its private pages
+    long = p1 + list(range(900, 940))
+    assert kv.alloc_slot_prefix(long) is None
+    # the matched shared pages belong to s1 (ref 1), untouched by rollback
+    assert all(kv._page_ref[p] == 1 for p in kv._slot_pages[s1])
+    kv.free_slot(s1)
+    assert kv.get_stats()["pages_cached"] == 3       # registered full pages
+
+
+def test_prefix_disabled_via_config(params):
+    eng = ContinuousEngine(SPEC, params=params, config=_cfg(False))
+    eng.generate(_reqs())
+    m = eng.get_metrics()
+    assert m["prefix_hit_admissions"] == 0
+    assert m["kv"]["prefix_queries"] == 0
