@@ -1,0 +1,371 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+No reference counterpart (the reference's "model" is an asyncio sleep,
+SURVEY.md §2.2) — this is a pure serving-throughput technique for the real
+engine: decode is HBM-bandwidth-bound, so scoring k draft tokens in ONE
+target forward (``models.base.forward_window``) converts k serial
+weight-streaming passes into one, at the cost of running a much smaller
+draft model serially.
+
+Algorithm (Leviathan et al. / Chen et al. rejection sampling):
+
+1. **Draft catch-up + proposal.** The draft syncs its KV cache over the ≤2
+   tokens it hasn't processed (one windowed forward), then proposes
+   ``k`` tokens autoregressively, recording its distribution q_i for each.
+2. **Target verify.** One windowed target forward over
+   ``[last, d_0 … d_{k-1}]`` yields p_0 … p_k and writes the window's KV.
+3. **Accept.** Greedy requests accept while ``argmax p_i == d_i`` — the
+   output is TOKEN-FOR-TOKEN the target's own greedy chain. Sampled
+   requests accept d_i with prob ``min(1, p_i[d_i]/q_i[d_i])`` and resample
+   the first rejection from ``norm(max(p−q, 0))`` — distributionally exact
+   for temperature sampling (top-k/top-p knobs are ignored in speculative
+   mode; temperature is honored).
+4. Rejected positions leave garbage KV past the accepted length in both
+   caches; it is masked by the length bookkeeping and overwritten by the
+   next round.
+
+Everything is static-shape: one jitted round per (batch-bucket, cache
+bucket), scanned on device; the host loop only checks "anyone still
+active" per round (SURVEY.md §7 hard-part #1 discipline).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import EngineConfig
+from ..models.base import (
+    ModelSpec,
+    Params,
+    forward_prefill,
+    forward_window,
+    init_params,
+    unembed,
+)
+from ..utils.tracing import LatencyStats
+from .engine import _next_bucket, _pow2_buckets
+from .types import GenerationRequest, GenerationResult
+
+logger = logging.getLogger(__name__)
+
+
+class SpeculativeEngine:
+    """Engine-interface implementation (same ``generate`` contract as
+    ``engine.Engine``) that decodes with draft-model speculation."""
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        draft_spec: ModelSpec,
+        params: Optional[Params] = None,
+        draft_params: Optional[Params] = None,
+        config: Optional[EngineConfig] = None,
+        seed: int = 0,
+        speculate_k: int = 4,
+    ) -> None:
+        self.spec = spec.validate()
+        self.draft_spec = draft_spec.validate()
+        if spec.vocab_size != draft_spec.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_spec.vocab_size} != target vocab "
+                f"{spec.vocab_size} — speculative decoding needs a shared "
+                "token space"
+            )
+        if speculate_k < 1:
+            raise ValueError("speculate_k must be >= 1")
+        self.k = int(speculate_k)
+        self.config = config or EngineConfig()
+        if params is None:
+            params = init_params(spec, jax.random.key(seed))
+        if draft_params is None:
+            draft_params = init_params(draft_spec, jax.random.key(seed + 100))
+        self.params = params
+        self.draft_params = draft_params
+        self._rng = jax.random.key(seed + 1)
+
+        cfg = self.config
+        self.batch_buckets = _pow2_buckets(cfg.max_slots)
+        self.prefill_buckets = sorted(
+            b for b in cfg.prefill_buckets if b <= spec.max_seq_len
+        ) or [min(128, spec.max_seq_len)]
+        self.seq_buckets = _pow2_buckets(
+            min(cfg.max_seq_len, spec.max_seq_len), start=128
+        )
+
+        spec_t, spec_d, k = self.spec, self.draft_spec, self.k
+
+        @jax.jit
+        def _prefill_both(pt, pd, tokens, seq_lens):
+            hid_t, tks, tvs = forward_prefill(spec_t, pt, tokens, seq_lens)
+            _hid_d, dks, dvs = forward_prefill(spec_d, pd, tokens, seq_lens)
+            b = tokens.shape[0]
+            last = hid_t[jnp.arange(b), seq_lens - 1]
+            return unembed(spec_t, pt, last), tks, tvs, dks, dvs
+
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def _round(pt, pd, tck, tcv, dck, dcv,
+                   lengths, last, active, produced,
+                   max_new, eos_ids, temps, key):
+            """One speculative round for every slot. Shapes:
+            tck/tcv [L,B,S,..] target cache; dck/dcv draft cache;
+            per-slot int32/bool vectors. Returns updated state + emitted
+            tokens [B, k+1] (-1 past the accepted run / inactive slots).
+
+            Invariant: both caches hold correct KV for positions
+            [0, lengths); ``last`` is the newest token, not yet cached.
+            The draft processes every token it proposes, so it needs no
+            separate catch-up state — garbage KV from rejected proposals
+            sits past ``lengths`` and is masked then overwritten.
+            """
+            b = lengths.shape[0]
+            bidx = jnp.arange(b)
+            k_draft, k_resid, k_bonus = jax.random.split(key, 3)
+            ones = jnp.ones_like(lengths)
+
+            # --- 1. draft processes `last` -> q_0
+            d_logits0, dck, dcv = forward_window(
+                spec_d, pd, last[:, None], ones, lengths, dck, dcv
+            )
+            q_logits = d_logits0[:, 0]                           # [B, V]
+
+            # --- 2. propose k tokens; q_probs collected per step
+            temp = jnp.maximum(temps, 1e-4)[:, None]
+            greedy = (temps <= 0.0)[:, None]
+
+            def propose(carry, step_key):
+                dck, dcv, q_logits, pos = carry
+                probs = jax.nn.softmax(q_logits / temp, axis=-1)
+                d_samp = jax.random.categorical(step_key, jnp.log(
+                    jnp.maximum(probs, 1e-30)), axis=-1)
+                d_tok = jnp.where(greedy[:, 0], q_logits.argmax(-1), d_samp)
+                nxt, dck, dcv = forward_window(
+                    spec_d, pd, d_tok[:, None], ones, pos, dck, dcv,
+                )
+                return (dck, dcv, nxt[:, 0], pos + 1), (d_tok, probs)
+
+            keys = jax.random.split(k_draft, k)
+            (dck, dcv, _q_last, _pos), (drafts, q_probs) = jax.lax.scan(
+                propose, (dck, dcv, q_logits, lengths + 1), keys
+            )
+            drafts = drafts.T                                    # [B, k]
+            q_probs = jnp.swapaxes(q_probs, 0, 1)                # [B, k, V]
+
+            # --- 3. target verify over [last, d_0..d_{k-1}]
+            window_t = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, tck, tcv = forward_window(
+                spec_t, pt, window_t, jnp.full_like(lengths, k + 1),
+                lengths, tck, tcv,
+            )                                                    # [B, k+1, V]
+            p_probs = jax.nn.softmax(t_logits / temp[:, :, None], axis=-1)
+
+            # --- 4. acceptance
+            p_at_d = jnp.take_along_axis(
+                p_probs[:, :k], drafts[:, :, None], axis=-1)[..., 0]
+            q_at_d = jnp.take_along_axis(
+                q_probs, drafts[:, :, None], axis=-1)[..., 0]
+            u = jax.random.uniform(k_resid, drafts.shape)
+            acc_samp = u * q_at_d < p_at_d
+            acc_greedy = p_probs[:, :k].argmax(-1) == drafts
+            accept = jnp.where(greedy, acc_greedy, acc_samp)     # [B, k]
+            acc_run = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+            n_acc = acc_run.sum(axis=1)                          # [B] 0..k
+
+            # final token: bonus sample from p_k when all accepted, else
+            # resample from the residual at the first rejected position
+            all_acc = n_acc == k
+            pos_r = jnp.minimum(n_acc, k - 1)
+            p_rej = p_probs[bidx, pos_r]                         # [B, V]
+            q_rej = q_probs[bidx, pos_r]
+            resid = jnp.maximum(p_rej - q_rej, 0.0)
+            resid_sum = resid.sum(-1, keepdims=True)
+            # degenerate residual (q covers p): fall back to p
+            resid = jnp.where(resid_sum > 1e-9, resid, p_rej)
+            resid = resid / resid.sum(-1, keepdims=True)
+            p_bonus = p_probs[bidx, jnp.int32(k)]
+            final_dist = jnp.where(all_acc[:, None], p_bonus, resid)
+            f_samp = jax.random.categorical(
+                k_bonus, jnp.log(jnp.maximum(final_dist, 1e-30)), axis=-1)
+            final = jnp.where(greedy[:, 0], final_dist.argmax(-1), f_samp)
+
+            # --- 5. bookkeeping (inactive slots frozen)
+            was_active = active
+            slot_pos = jnp.arange(k + 1)[None, :]
+            emit_mask = (slot_pos <= n_acc[:, None]) & was_active[:, None]
+            emitted = jnp.where(
+                emit_mask,
+                jnp.concatenate([drafts, jnp.zeros_like(last)[:, None]],
+                                axis=1).at[bidx, n_acc].set(final),
+                -1,
+            )
+            n_emit = jnp.where(was_active, n_acc + 1, 0)
+            produced = produced + n_emit
+            hit_eos = ((emitted == eos_ids[:, None]) &
+                       (eos_ids[:, None] >= 0)).any(axis=1)
+            done = hit_eos | (produced >= max_new)
+            active = was_active & ~done
+            lengths = jnp.where(was_active, lengths + n_acc + 1, lengths)
+            last = jnp.where(was_active, final, last)
+            return (tck, tcv, dck, dcv, lengths, last,
+                    active, produced, emitted, n_acc)
+
+        self._prefill_both = _prefill_both
+        self._round = _round
+
+        # metrics
+        self.prefill_stats = LatencyStats()
+        self.round_stats = LatencyStats()
+        self._total_requests = 0
+        self._total_prompt_tokens = 0
+        self._total_generated = 0
+        self._total_rounds = 0
+        self._total_accepted = 0
+        self._total_proposed = 0
+        self._warned_topk = False
+
+    # ------------------------------------------------------------ generate
+
+    def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
+        if not requests:
+            return []
+        if min(len(r.prompt) for r in requests) < 1:
+            raise ValueError("empty prompt")
+        if any(r.top_k > 0 or r.top_p < 1.0 for r in requests) and \
+                not self._warned_topk:
+            self._warned_topk = True
+            logger.warning(
+                "speculative engine honors temperature only — top_k/top_p "
+                "on these requests are ignored (rejection sampling is "
+                "exact for the temperature-adjusted distribution)")
+        self._total_requests += len(requests)
+        n = len(requests)
+        bb = _next_bucket(n, self.batch_buckets)
+        max_prompt = min(max(len(r.prompt) for r in requests),
+                         max(self.prefill_buckets))
+        tb = _next_bucket(max_prompt, self.prefill_buckets)
+        max_new = max(r.max_new_tokens for r in requests)
+        total_cap = max(tb + self.k + 1, _next_bucket(
+            min(max_prompt + max_new + self.k + 1, self.seq_buckets[-1]),
+            self.seq_buckets,
+        ))
+
+        tokens = np.zeros((bb, tb), dtype=np.int32)
+        seq_lens = np.ones((bb,), dtype=np.int32)
+        max_new_arr = np.zeros((bb,), dtype=np.int32)
+        eos = np.full((bb,), -1, dtype=np.int32)
+        temps = np.zeros((bb,), dtype=np.float32)
+        for i, r in enumerate(requests):
+            p = r.prompt[-tb:]
+            tokens[i, : len(p)] = p
+            seq_lens[i] = len(p)
+            max_new_arr[i] = max(1, min(r.max_new_tokens,
+                                        total_cap - len(p) - self.k - 1))
+            eos[i] = r.eos_id
+            temps[i] = r.temperature
+
+        t0 = time.perf_counter()
+        logits, tks, tvs, dks, dvs = self._prefill_both(
+            self.params, self.draft_params,
+            jnp.asarray(tokens), jnp.asarray(seq_lens),
+        )
+        # first token from the target prefill logits
+        temp = np.maximum(temps, 1e-4)
+        self._rng, k0 = jax.random.split(self._rng)
+        probs0 = jax.nn.softmax(jnp.asarray(logits) / temp[:, None], axis=-1)
+        samp0 = np.asarray(jax.random.categorical(
+            k0, jnp.log(jnp.maximum(probs0, 1e-30)), axis=-1))
+        first = np.where(temps <= 0.0, np.asarray(logits).argmax(-1), samp0)
+
+        L_t = self.spec.n_layers
+        L_d = self.draft_spec.n_layers
+        dt = jnp.dtype(self.config.kv_dtype)
+        shape_t = (L_t, bb, total_cap, self.spec.n_kv_heads,
+                   self.spec.head_dim)
+        shape_d = (L_d, bb, total_cap, self.draft_spec.n_kv_heads,
+                   self.draft_spec.head_dim)
+        tck = jnp.zeros(shape_t, dt).at[:, :, :tb].set(tks.astype(dt))
+        tcv = jnp.zeros(shape_t, dt).at[:, :, :tb].set(tvs.astype(dt))
+        dck = jnp.zeros(shape_d, dt).at[:, :, :tb].set(dks.astype(dt))
+        dcv = jnp.zeros(shape_d, dt).at[:, :, :tb].set(dvs.astype(dt))
+
+        is_real = np.zeros((bb,), bool)
+        is_real[:n] = True
+        produced_np = is_real.astype(np.int32)
+        hit = is_real & (first == eos) & (eos >= 0)
+        active_np = is_real & ~hit & (produced_np < max_new_arr)
+        out_tokens: List[List[int]] = [[int(first[i])] for i in range(n)]
+        jax.block_until_ready(logits)
+        ttft = time.perf_counter() - t0
+        self.prefill_stats.add(ttft)
+
+        lengths = jnp.asarray(seq_lens)
+        last = jnp.asarray(np.where(first >= 0, first, 0).astype(np.int32))
+        active = jnp.asarray(active_np)
+        produced = jnp.asarray(produced_np)
+        max_new_j = jnp.asarray(max_new_arr)
+        eos_j = jnp.asarray(eos)
+        temps_j = jnp.asarray(temps)
+
+        t1 = time.perf_counter()
+        while bool(np.asarray(jax.device_get(active.any()))):
+            self._rng, kr = jax.random.split(self._rng)
+            (tck, tcv, dck, dcv, lengths, last, active,
+             produced, emitted, n_acc) = self._round(
+                self.params, self.draft_params, tck, tcv, dck, dcv,
+                lengths, last, active, produced,
+                max_new_j, eos_j, temps_j, kr,
+            )
+            em = np.asarray(emitted)
+            live = int((em[:, 0] >= 0).sum())
+            self._total_rounds += 1
+            self._total_accepted += int(np.asarray(n_acc)[em[:, 0] >= 0].sum())
+            self._total_proposed += self.k * live
+            for i in range(n):
+                for t in em[i]:
+                    if t >= 0:
+                        out_tokens[i].append(int(t))
+        decode_t = time.perf_counter() - t1
+        self.round_stats.add(decode_t)
+
+        results = []
+        for i, r in enumerate(requests):
+            toks = out_tokens[i][: r.max_new_tokens]
+            stopped = r.eos_id >= 0 and r.eos_id in toks
+            if stopped:
+                toks = toks[: toks.index(r.eos_id) + 1]
+            self._total_prompt_tokens += len(r.prompt)
+            self._total_generated += len(toks)
+            results.append(GenerationResult(
+                request_id=r.request_id or f"spec-{self._total_requests}-{i}",
+                tokens=toks,
+                finish_reason="stop" if stopped else "length",
+                prompt_tokens=len(r.prompt),
+                ttft_s=ttft,
+                decode_s=decode_t,
+            ))
+        return results
+
+    # ------------------------------------------------------------ metrics
+
+    def get_metrics(self) -> Dict[str, Any]:
+        acc_rate = (self._total_accepted / self._total_proposed
+                    if self._total_proposed else 0.0)
+        return {
+            "total_requests": self._total_requests,
+            "total_prompt_tokens": self._total_prompt_tokens,
+            "total_generated_tokens": self._total_generated,
+            "speculate_k": self.k,
+            "rounds": self._total_rounds,
+            "draft_acceptance_rate": acc_rate,
+            "tokens_per_round": ((self._total_accepted + self._total_rounds)
+                                 / self._total_rounds
+                                 if self._total_rounds else 0.0),
+            "prefill": self.prefill_stats.snapshot(),
+            "decode": self.round_stats.snapshot(),
+        }

@@ -101,6 +101,36 @@ def engine_from_config(cfg):
               "prefix_cache"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
+    spec_k = int(cfg.metadata.get("speculative", 0))
+    if spec_k:
+        # draft-model speculative decoding (engine/speculative.py):
+        # metadata speculative=K, draft_size=<spec name>, optional
+        # draft_path=<HF checkpoint dir>
+        from ..engine.speculative import SpeculativeEngine
+
+        draft_size = cfg.metadata.get("draft_size", "")
+        if not draft_size and not cfg.metadata.get("draft_path"):
+            raise ValueError(
+                "speculative decoding needs metadata draft_size and/or "
+                "draft_path")
+        draft_path = cfg.metadata.get("draft_path", "")
+        if draft_path and not os.path.isdir(draft_path):
+            # a typo'd/unmounted checkpoint must not silently fall back to
+            # a random-weight draft (≈0% acceptance ⇒ slower than plain)
+            raise ValueError(
+                f"draft_path {draft_path!r} is not a directory")
+        if draft_path:
+            d_spec = spec_from_hf_config(draft_path)
+            d_spec = d_spec.replace(max_seq_len=min(cfg.max_seq_len,
+                                                    d_spec.max_seq_len))
+            d_params = load_checkpoint(draft_path, d_spec)
+        else:
+            d_spec = spec_for_architecture(arch, size=draft_size,
+                                           max_seq_len=cfg.max_seq_len)
+            d_params = None
+        return SpeculativeEngine(spec, d_spec, params=params,
+                                 draft_params=d_params, config=ecfg,
+                                 speculate_k=spec_k)
     if cfg.metadata.get("role") == "prefill":
         # disaggregated prefill pool: prefill-only engine (engine/disagg.py)
         from ..engine.disagg import PrefillEngine

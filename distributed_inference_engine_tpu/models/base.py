@@ -325,6 +325,57 @@ def forward_prefill_suffix(
     return x, ks, vs
 
 
+def forward_window(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,      # [B, W] token window per slot (right-padded)
+    n_valid: jnp.ndarray,     # [B] valid tokens in each window
+    start: jnp.ndarray,       # [B] absolute position of window token 0
+    cache_k: jnp.ndarray,     # [L, B, S, Hkv, Dh] contiguous KV cache
+    cache_v: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Multi-token decode ("verify") step: process a small window of W
+    tokens at absolute positions ``start + i`` against the cache.
+
+    The workhorse of speculative decoding (``engine/speculative.py``): the
+    target model scores k draft tokens in ONE forward instead of k serial
+    decode steps, and the draft model uses it to catch its cache up after
+    a rejection. Window K/V is scattered into the cache at its absolute
+    positions (invalid window slots dropped); attention sees the cache
+    prefix (< start) plus the causal window — ``ops.attention
+    .suffix_attention`` with the cache as context.
+
+    Returns (logits [B, W, V] fp32, new cache_k, new cache_v). Position i
+    of the logits is the next-token distribution AFTER window token i.
+    """
+    b, w = tokens.shape
+    s = cache_k.shape[2]
+    positions = start[:, None] + jnp.arange(w)[None, :]
+    x = embed(spec, params, tokens, positions)
+    batch_idx = jnp.arange(b)[:, None]
+    # invalid window slots scatter out of range -> dropped
+    pos_w = jnp.where(jnp.arange(w)[None, :] < n_valid[:, None],
+                      positions, s)
+
+    def body(x, per_layer):
+        blk, ck, cv = per_layer
+        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+        q, k, v = _qkv(spec, blk, h, positions)      # k,v: [B, W, Hkv, Dh]
+        ck = ck.at[batch_idx, pos_w].set(k.astype(ck.dtype), mode="drop")
+        cv = cv.at[batch_idx, pos_w].set(v.astype(cv.dtype), mode="drop")
+        attn = suffix_attention(
+            q, ck.astype(q.dtype), cv.astype(q.dtype), start, k, v, n_valid
+        )
+        x = x + _out_proj(spec, blk, attn)
+        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+        m, _ = _mlp(spec, blk, h2)
+        x = x + m
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
+    return unembed(spec, params, x), new_k, new_v
+
+
 # ------------------------------------------------------------------- decode
 
 

@@ -23,9 +23,13 @@ SYS = list(range(1, 25))          # 24 tokens = 3 full pages of shared prefix
 
 
 def _cfg(prefix_cache=True, num_pages=64, **over):
+    # kv_dtype matches the spec dtype so cache-on/cache-off comparisons are
+    # exact (bf16 pages would round the prefix KV the cache-off path keeps
+    # at full precision — a near-tie argmax could flip spuriously)
     base = dict(max_slots=4, max_seq_len=128, page_size=PAGE,
                 num_pages=num_pages, decode_steps_per_call=4,
-                attention_impl="xla", prefix_cache=prefix_cache)
+                attention_impl="xla", prefix_cache=prefix_cache,
+                kv_dtype="float32")
     base.update(over)
     return EngineConfig(**base)
 

@@ -1,0 +1,114 @@
+"""Speculative decoding tests (engine/speculative.py).
+
+Correctness bar: greedy speculative output is TOKEN-FOR-TOKEN the target
+engine's own greedy chain for any draft and any k — speculation may only
+change latency, never content. Acceptance math is validated with
+draft == target (everything must be accepted)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import EngineConfig, ModelConfig
+from distributed_inference_engine_tpu.engine.engine import Engine
+from distributed_inference_engine_tpu.engine.speculative import (
+    SpeculativeEngine,
+)
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models import engine_from_config
+from distributed_inference_engine_tpu.models.base import init_params
+from distributed_inference_engine_tpu.models.llama import llama_spec
+
+SPEC = llama_spec("llama-tiny", max_seq_len=128)
+DRAFT = llama_spec("llama-tiny", max_seq_len=128, n_layers=2, d_model=128,
+                   n_heads=4, n_kv_heads=2, d_ff=256)
+
+
+def _cfg():
+    return EngineConfig(max_slots=4, max_seq_len=128)
+
+
+def _reqs():
+    return [
+        GenerationRequest(prompt=[1, 2, 3, 4, 5], max_new_tokens=16,
+                          temperature=0.0, request_id="a"),
+        GenerationRequest(prompt=[9, 8, 7], max_new_tokens=12,
+                          temperature=0.0, request_id="b"),
+    ]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(SPEC, jax.random.key(0))
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_greedy_speculative_matches_plain_engine(params, k):
+    base = {r.request_id: r.tokens
+            for r in Engine(SPEC, params=params, config=_cfg()
+                            ).generate(_reqs())}
+    se = SpeculativeEngine(SPEC, DRAFT, params=params, config=_cfg(),
+                           speculate_k=k)
+    out = {r.request_id: r.tokens for r in se.generate(_reqs())}
+    assert out == base
+
+
+def test_identical_draft_accepts_everything(params):
+    se = SpeculativeEngine(SPEC, SPEC, params=params, draft_params=params,
+                           config=_cfg(), speculate_k=4)
+    se.generate([GenerationRequest(prompt=[1, 2, 3, 4, 5],
+                                   max_new_tokens=20, temperature=0.0)])
+    m = se.get_metrics()
+    assert m["draft_acceptance_rate"] > 0.95
+    assert m["tokens_per_round"] == pytest.approx(5.0)
+    # 20 tokens in ~4 rounds instead of ~20 decode steps
+    assert m["rounds"] <= 5
+
+
+def test_eos_respected(params):
+    # find the greedy chain, then set eos to its third token
+    base = Engine(SPEC, params=params, config=_cfg()).generate(
+        [GenerationRequest(prompt=[1, 2, 3, 4, 5], max_new_tokens=10,
+                           temperature=0.0)])[0].tokens
+    eos = base[2]
+    se = SpeculativeEngine(SPEC, SPEC, params=params, draft_params=params,
+                           config=_cfg(), speculate_k=4)
+    out = se.generate([GenerationRequest(prompt=[1, 2, 3, 4, 5],
+                                         max_new_tokens=10,
+                                         temperature=0.0, eos_id=eos)])[0]
+    assert out.tokens == base[:3]
+    assert out.finish_reason == "stop"
+
+
+def test_sampled_mode_runs_and_respects_max_new(params):
+    se = SpeculativeEngine(SPEC, DRAFT, params=params, config=_cfg(),
+                           speculate_k=3, seed=7)
+    outs = se.generate([GenerationRequest(prompt=[4, 5, 6],
+                                          max_new_tokens=9,
+                                          temperature=0.9,
+                                          request_id=f"s{i}")
+                        for i in range(3)])
+    for r in outs:
+        assert len(r.tokens) == 9
+        assert all(0 <= t < SPEC.vocab_size for t in r.tokens)
+
+
+def test_vocab_mismatch_rejected(params):
+    bad = llama_spec("llama-tiny", max_seq_len=128, vocab_size=999)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeEngine(SPEC, bad, params=params, config=_cfg())
+
+
+def test_engine_from_config_speculative():
+    cfg = ModelConfig(
+        name="s", architecture="llama", dtype="float32", max_seq_len=64,
+        max_batch_size=2,
+        metadata={"size": "llama-tiny", "speculative": 3,
+                  "draft_size": "llama-tiny"},
+    )
+    eng = engine_from_config(cfg)
+    assert isinstance(eng, SpeculativeEngine)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                          max_new_tokens=5)])
+    assert len(out[0].tokens) == 5
+    assert eng.get_metrics()["speculate_k"] == 3
