@@ -346,6 +346,119 @@ class Coordinator:
             self.cache.set(cache_key, stripped)
         return result
 
+    async def submit_stream(
+        self,
+        model: str,
+        prompt: Optional[Sequence[int]] = None,
+        on_tokens=None,
+        version: str = "1.0",
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int = -1,
+        key: Optional[str] = None,
+        request_id: Optional[str] = None,
+        text: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Streaming variant of ``submit``: ``on_tokens(tokens)`` fires as
+        the worker decodes. Bypasses the response cache and the batcher —
+        a streaming request is dispatched immediately on its own (it still
+        shares the worker's rolling decode batch with everything else).
+        Not yet supported on disaggregated deployments."""
+        if not self._running:
+            raise RuntimeError("coordinator is not running")
+        if model in self._disagg:
+            raise ValueError(
+                "streaming is not supported on disaggregated deployments")
+        tokenizer = None
+        if text is not None:
+            if prompt is not None:
+                raise ValueError("pass prompt or text, not both")
+            tokenizer = self._tokenizer_for(model)
+            prompt = tokenizer.encode(text)
+        if not prompt:
+            raise ValueError("empty prompt")
+        self._submitted += 1
+        request_id = request_id or new_request_id()
+        affinity = key if key is not None else request_id
+        trace = RequestTrace(request_id=request_id)
+        trace.mark("received")
+
+        sharded = bool(self.registry.all_shards(model, version))
+        if sharded:
+            worker_id = self.router.route_request(
+                model, version, affinity).worker.worker_id
+        else:
+            worker_id = self.lb.get_worker().worker_id
+
+        req = request_from_dict({
+            "prompt": list(prompt), "max_new_tokens": max_new_tokens,
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+            "eos_id": eos_id, "request_id": request_id,
+        })
+        delivered = 0
+        cb = on_tokens or (lambda toks: None)
+
+        def counting_cb(toks):
+            nonlocal delivered
+            delivered += len(toks)
+            cb(toks)
+
+        try:
+            result = await self._stream_once(model, worker_id, req,
+                                             counting_cb)
+        except _TRANSPORT_ERRORS:
+            # retry on an alternate worker — but only while NOTHING has
+            # streamed to the caller yet (a restart would replay tokens)
+            if delivered:
+                raise
+            alt = self._pick_alternate(model, version, worker_id,
+                                       affinity, sharded)
+            if alt is None:
+                raise
+            logger.warning("stream dispatch to %s failed — retrying on %s",
+                           worker_id, alt)
+            worker_id = alt
+            result = await self._stream_once(model, worker_id, req,
+                                             counting_cb)
+        trace.mark("done")
+        out = result_to_dict(result)
+        out["cached"] = False
+        out["streamed"] = True
+        out["metadata"]["worker_id"] = worker_id
+        out["trace"] = trace.to_dict()
+        if tokenizer is not None:
+            out["text"] = tokenizer.decode(out.get("tokens", []))
+        return out
+
+    async def _stream_once(self, model: str, worker_id: str, req,
+                           on_tokens) -> Any:
+        """One streaming dispatch with the same health accounting as
+        ``_dispatch_once``."""
+        client = (self.router.client_for(worker_id)
+                  if worker_id in self.router.workers
+                  else self.lb.client_for(worker_id))
+        self.lb.acquire(worker_id)
+        t0 = time.perf_counter()
+        try:
+            result = await client.generate_stream(
+                model, req, on_tokens,
+                timeout=self.config.dispatch_timeout_s,
+            )
+        except Exception as e:
+            self.lb.update_stats(worker_id, success=False,
+                                 latency_s=time.perf_counter() - t0)
+            if not isinstance(e, WorkerRPCError):
+                self.router.mark_worker_failure(worker_id)
+            raise
+        finally:
+            self.lb.release(worker_id)
+        self.lb.update_stats(worker_id, success=True,
+                             latency_s=time.perf_counter() - t0)
+        self.router.mark_worker_success(worker_id)
+        return result
+
     def _tokenizer_for(self, model: str):
         """Per-model tokenizer keyed by (name, path) so a redeploy with a new
         checkpoint path picks up fresh vocab files."""

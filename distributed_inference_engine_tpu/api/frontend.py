@@ -19,7 +19,7 @@ import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ModelConfig, ServerConfig
-from ..utils.rpc import FramedRPCClient, FramedServerMixin
+from ..utils.rpc import FramedRPCClient, FramedServerMixin, relay_stream
 from .coordinator import Coordinator
 
 logger = logging.getLogger(__name__)
@@ -43,6 +43,9 @@ class CoordinatorServer(FramedServerMixin):
             "remove_worker": self._rpc_remove_worker,
             "stats": self._rpc_stats,
             "models": self._rpc_models,
+        }
+        self._stream_methods = {
+            "generate_stream": self._rpc_generate_stream,
         }
 
     @property
@@ -94,6 +97,27 @@ class CoordinatorServer(FramedServerMixin):
             no_cache=bool(msg.get("no_cache", False)),
         )
 
+    async def _rpc_generate_stream(self, msg: Dict[str, Any], send
+                                   ) -> Dict[str, Any]:
+        """End-to-end streaming: worker token chunks relay through the
+        coordinator to the client connection."""
+        queue: asyncio.Queue = asyncio.Queue()
+        fut = asyncio.ensure_future(self.coordinator.submit_stream(
+            model=msg["model"],
+            prompt=msg.get("prompt"),
+            text=msg.get("text"),
+            on_tokens=queue.put_nowait,
+            version=msg.get("version", "1.0"),
+            max_new_tokens=int(msg.get("max_new_tokens", 16)),
+            temperature=float(msg.get("temperature", 0.0)),
+            top_k=int(msg.get("top_k", 0)),
+            top_p=float(msg.get("top_p", 1.0)),
+            eos_id=int(msg.get("eos_id", -1)),
+            key=msg.get("key"),
+            request_id=msg.get("request_id"),
+        ))
+        return await relay_stream(fut, queue, send)
+
     async def _rpc_deploy_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         cfg = ModelConfig.from_dict(msg["config"])
         n = await self.coordinator.deploy_model(
@@ -132,6 +156,18 @@ class CoordinatorClient(FramedRPCClient):
         coordinator tokenizes and the result carries ``"text"``)."""
         return await self.call(
             "generate", model=model,
+            prompt=list(prompt) if prompt is not None else None, **kwargs)
+
+    async def generate_stream(self, model: str, on_tokens,
+                              prompt: Optional[List[int]] = None,
+                              **kwargs: Any) -> Dict[str, Any]:
+        """Streaming generate: ``on_tokens(tokens)`` fires per decoded
+        chunk end-to-end (worker → coordinator → here); returns the final
+        result dict."""
+        return await self.call_stream(
+            "generate_stream",
+            lambda frame: on_tokens(list(frame.get("tokens", []))),
+            model=model,
             prompt=list(prompt) if prompt is not None else None, **kwargs)
 
     async def deploy_model(self, cfg: ModelConfig,

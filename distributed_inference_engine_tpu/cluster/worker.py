@@ -37,7 +37,12 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 from ..config import ModelConfig, ServerConfig
 from ..engine.types import GenerationRequest, GenerationResult
 from ..utils.framing import FrameError, read_frame, write_frame
-from ..utils.rpc import FramedRPCClient, FramedServerMixin, RPCError
+from ..utils.rpc import (
+    FramedRPCClient,
+    FramedServerMixin,
+    RPCError,
+    relay_stream,
+)
 from ..utils.tracing import LatencyStats
 
 logger = logging.getLogger(__name__)
@@ -189,8 +194,14 @@ class WorkerServer(FramedServerMixin):
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
             "metrics": self._rpc_metrics,
+            "profile": self._rpc_profile,
             "shutdown": self._rpc_shutdown,
         }
+        # streaming methods write chunk frames ahead of the final envelope
+        self._stream_methods = {
+            "generate_stream": self._rpc_generate_stream,
+        }
+        self._profiling_dir: Optional[str] = None
         # prefill-pool side: persistent clients to decode-pool peers,
         # keyed by (host, port) — the KV handoff goes peer-to-peer over
         # DCN, not back through the coordinator
@@ -335,15 +346,15 @@ class WorkerServer(FramedServerMixin):
         return f"request timed out after {self.config.request_timeout}s"
 
     def _on_handler_error(self, method: str, exc: Exception) -> None:
-        if method == "generate":
+        if method in ("generate", "generate_stream"):
             self._error_count += 1
 
     def _after_dispatch(self, method: str, req_id: str,
                         duration_s: float, response: Dict[str, Any]) -> None:
-        if method == "generate":
+        if method in ("generate", "generate_stream"):
             self.latency.add(duration_s)
-            logger.info("worker %s: generate id=%s %.1fms ok=%s",
-                        self.worker_id, req_id, duration_s * 1e3,
+            logger.info("worker %s: %s id=%s %.1fms ok=%s",
+                        self.worker_id, method, req_id, duration_s * 1e3,
                         response["success"])
 
     # -- RPC methods ---------------------------------------------------------
@@ -371,6 +382,55 @@ class WorkerServer(FramedServerMixin):
                 self._executor, engine.generate, reqs
             )
         return {"model": name, "results": [result_to_dict(r) for r in results]}
+
+    # -- streaming (token chunks ahead of the final result) -----------------
+
+    async def _rpc_generate_stream(self, msg: Dict[str, Any], send) -> Dict[str, Any]:
+        """Stream one request's tokens as they decode: chunk frames
+        ``{"tokens": [...]}`` ride the connection ahead of the final
+        result envelope. Continuous engines only (the rolling batch emits
+        per-chunk; a static engine runs to completion in one call — use
+        ``generate`` there)."""
+        name, _engine = self._engine_for(msg, "generate")
+        pump = self._pumps.get(name)
+        if pump is None:
+            raise ValueError(
+                f"model {name!r} is not a continuous engine — streaming "
+                "needs metadata.continuous=1")
+        req = request_from_dict(msg.get("request") or {})
+        self._request_count += 1
+        queue: asyncio.Queue = asyncio.Queue()
+        fut = asyncio.ensure_future(
+            pump.generate_streaming(req, queue.put_nowait))
+        result = await relay_stream(fut, queue, send)
+        return {"model": name, "result": result_to_dict(result)}
+
+    # -- profiling (SURVEY.md §5 tracing plan: XLA/TPU timeline capture) ----
+
+    async def _rpc_profile(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Start/stop a ``jax.profiler`` trace on this worker. The trace
+        directory is loadable in TensorBoard/XProf for XLA timelines —
+        the real-engine upgrade of the reference's wall-clock-only
+        "tracing" (``src/worker.py:126-133``)."""
+        import jax
+
+        action = msg.get("action")
+        if action == "start":
+            if self._profiling_dir is not None:
+                raise ValueError(
+                    f"profiling already active -> {self._profiling_dir}")
+            trace_dir = msg.get("trace_dir") or f"/tmp/{self.worker_id}-trace"
+            jax.profiler.start_trace(trace_dir)
+            self._profiling_dir = trace_dir
+            return {"profiling": True, "trace_dir": trace_dir}
+        if action == "stop":
+            if self._profiling_dir is None:
+                raise ValueError("profiling is not active")
+            jax.profiler.stop_trace()
+            out, self._profiling_dir = self._profiling_dir, None
+            return {"profiling": False, "trace_dir": out}
+        raise ValueError(f"unknown profile action {action!r} "
+                         "(use 'start' or 'stop')")
 
     # -- disaggregated prefill/decode (engine/disagg.py; SURVEY.md §2.3) ----
 
@@ -596,6 +656,21 @@ class WorkerClient(FramedRPCClient):
             timeout=timeout,
         )
         return [result_from_dict(d) for d in result["results"]]
+
+    async def generate_stream(
+        self, model: str, request: GenerationRequest, on_tokens,
+        timeout: Optional[float] = None,
+    ) -> GenerationResult:
+        """Stream one request: ``on_tokens(tokens)`` fires per decoded
+        chunk; returns the final (authoritative) result. ``timeout``
+        bounds the gap between frames, not the whole generation."""
+        result = await self.call_stream(
+            "generate_stream",
+            lambda frame: on_tokens(list(frame.get("tokens", []))),
+            model=model, request=request_to_dict(request),
+            timeout=timeout,
+        )
+        return result_from_dict(result["result"])
 
     async def prefill(self, model: str, requests: List[GenerationRequest],
                       timeout: Optional[float] = None) -> List[Any]:

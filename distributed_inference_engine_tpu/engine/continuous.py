@@ -28,6 +28,7 @@ runs out (pool pressure or ``max_seq_len``) are finished with reason
 from __future__ import annotations
 
 import collections
+import logging
 import time
 from functools import partial
 from typing import Any, Deque, Dict, List, Optional, Tuple
@@ -53,15 +54,17 @@ from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache
 from .types import GenerationRequest, GenerationResult
 
+logger = logging.getLogger(__name__)
+
 
 class _Slot:
     """Host-side bookkeeping for one live sequence."""
 
     __slots__ = ("request", "slot_id", "prompt_len", "produced", "tokens",
-                 "admitted_at", "first_token_at")
+                 "admitted_at", "first_token_at", "on_tokens", "streamed")
 
     def __init__(self, request: GenerationRequest, slot_id: int,
-                 prompt_len: int) -> None:
+                 prompt_len: int, on_tokens=None) -> None:
         self.request = request
         self.slot_id = slot_id
         self.prompt_len = prompt_len
@@ -69,6 +72,8 @@ class _Slot:
         self.tokens: List[int] = []
         self.admitted_at = time.perf_counter()
         self.first_token_at = 0.0
+        self.on_tokens = on_tokens      # streaming: cb(new_tokens: List[int])
+        self.streamed = 0               # tokens already emitted to the cb
 
 
 class ContinuousEngine:
@@ -116,11 +121,13 @@ class ContinuousEngine:
         self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
         self._prefix_hit_admissions = 0
 
-        # ---- queues / state
-        self._waiting: Deque[GenerationRequest] = collections.deque()
-        # disaggregated admissions: (request, handoff) pairs whose prefill
-        # already ran on a prefill-pool worker (engine/disagg.py)
-        self._waiting_prefilled: Deque[Tuple[GenerationRequest, Any]] = (
+        # ---- queues / state: (request, stream cb or None)
+        self._waiting: Deque[Tuple[GenerationRequest, Any]] = (
+            collections.deque()
+        )
+        # disaggregated admissions whose prefill already ran on a
+        # prefill-pool worker (engine/disagg.py): (request, handoff, cb)
+        self._waiting_prefilled: Deque[Tuple[GenerationRequest, Any, Any]] = (
             collections.deque()
         )
         self._slots: Dict[int, _Slot] = {}
@@ -215,17 +222,23 @@ class ContinuousEngine:
 
     # ------------------------------------------------------------- submit
 
-    def submit(self, request: GenerationRequest) -> str:
-        """Enqueue; returns the request id (assigned if empty)."""
+    def submit(self, request: GenerationRequest, on_tokens=None) -> str:
+        """Enqueue; returns the request id (assigned if empty).
+
+        ``on_tokens`` (optional) streams incremental output: called on the
+        engine's thread with each batch of newly generated tokens, already
+        trimmed to ``max_new_tokens``/EOS — the final ``GenerationResult``
+        remains authoritative and contains the full sequence."""
         if not request.prompt:
             raise ValueError("empty prompt")
         self._total_requests += 1
         if not request.request_id:
             request.request_id = f"creq-{self._total_requests}"
-        self._waiting.append(request)
+        self._waiting.append((request, on_tokens))
         return request.request_id
 
-    def submit_prefilled(self, request: GenerationRequest, handoff: Any) -> str:
+    def submit_prefilled(self, request: GenerationRequest, handoff: Any,
+                         on_tokens=None) -> str:
         """Enqueue a request whose prefill ran on a prefill-pool worker.
 
         ``handoff`` is an ``engine.disagg.PrefillHandoff``: the prompt KV
@@ -249,7 +262,7 @@ class ContinuousEngine:
         self._total_requests += 1
         if not request.request_id:
             request.request_id = f"creq-{self._total_requests}"
-        self._waiting_prefilled.append((request, handoff))
+        self._waiting_prefilled.append((request, handoff, on_tokens))
         return request.request_id
 
     # ---------------------------------------------------------- admission
@@ -259,7 +272,7 @@ class ContinuousEngine:
         prefill program — the disaggregated half of ``_try_admit``."""
         admitted = 0
         while self._waiting_prefilled:
-            req, handoff = self._waiting_prefilled[0]
+            req, handoff, on_tok = self._waiting_prefilled[0]
             prompt_len = handoff.prompt_len
             slot = self.kv.alloc_slot(prompt_len)
             if slot is None:
@@ -284,20 +297,23 @@ class ContinuousEngine:
             )
             self.kv.swap(kp, vp)
             self._total_prompt_tokens += prompt_len
-            self._install_slot(req, slot, prompt_len, handoff.first_token, t0)
+            self._install_slot(req, slot, prompt_len, handoff.first_token,
+                               t0, on_tok)
         return admitted
 
     def _install_slot(self, req: GenerationRequest, slot: int,
-                      prompt_len: int, first: int, t0: float) -> None:
+                      prompt_len: int, first: int, t0: float,
+                      on_tokens=None) -> None:
         """Shared tail of admission: host bookkeeping + device slot state
         for a sequence whose prompt KV is in pages and whose first token is
         ``first``."""
-        state = _Slot(req, slot, prompt_len)
+        state = _Slot(req, slot, prompt_len, on_tokens)
         state.tokens.append(first)
         state.produced = 1
         state.first_token_at = time.perf_counter()
         self._slots[slot] = state
         self.prefill_stats.add(state.first_token_at - t0)
+        self._emit_stream(state)
 
         done = (req.eos_id >= 0 and first == req.eos_id) or \
             req.max_new_tokens <= 1
@@ -320,7 +336,7 @@ class ContinuousEngine:
         """Prefill waiting requests into free slots; returns #admitted."""
         admitted = self._admit_prefilled()
         while self._waiting:
-            req = self._waiting[0]
+            req, on_tok = self._waiting[0]
             # overlong prompts keep their tail (sliding-window truncation,
             # same policy as Engine.generate); cap leaves ≥1 decode position
             prompt = req.prompt[-(self.max_seq_len - 1):]
@@ -365,7 +381,7 @@ class ContinuousEngine:
             first = int(np.asarray(sample_tokens(logits, sampling, k0))[0])
 
             self._total_prompt_tokens += len(prompt)
-            self._install_slot(req, slot, len(prompt), first, t0)
+            self._install_slot(req, slot, len(prompt), first, t0, on_tok)
         return admitted
 
     def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int):
@@ -395,6 +411,30 @@ class ContinuousEngine:
         )
         self.kv.swap(kp, vp)
         return logits
+
+    # ---------------------------------------------------------- streaming
+
+    def _emit_stream(self, state: _Slot) -> None:
+        """Push newly generated tokens to the slot's streaming callback,
+        trimmed exactly like ``_finish`` trims the final result (cap at
+        max_new_tokens, cut after EOS) so a streaming consumer never sees
+        tokens the result won't contain."""
+        cb = state.on_tokens
+        if cb is None:
+            return
+        req = state.request
+        toks = state.tokens[: req.max_new_tokens]
+        if req.eos_id >= 0 and req.eos_id in toks:
+            toks = toks[: toks.index(req.eos_id) + 1]
+        if len(toks) > state.streamed:
+            fresh = toks[state.streamed:]
+            state.streamed = len(toks)
+            try:
+                cb(fresh)
+            except Exception:
+                logger.exception("stream callback failed for %s",
+                                 req.request_id)
+                state.on_tokens = None     # don't retry a broken consumer
 
     # ------------------------------------------------------------- finish
 
@@ -468,6 +508,7 @@ class ContinuousEngine:
             col = toks_np[:, slot]
             state.tokens.extend(int(t) for t in col if t >= 0)
             state.produced = len(state.tokens)
+            self._emit_stream(state)
             if not active_np[slot]:
                 req = state.request
                 reason = ("stop" if req.eos_id >= 0 and

@@ -42,8 +42,8 @@ class EnginePump:
         self.engine = engine
         self.idle_wait_s = idle_wait_s          # safety-net poll when idle
         self.error_backoff_s = error_backoff_s  # pause after a failed step
-        # (request, optional prefill handoff, future, caller's loop)
-        self._inbox: List[Tuple[GenerationRequest, Any, asyncio.Future,
+        # (request, optional handoff, optional stream cb, future, loop)
+        self._inbox: List[Tuple[GenerationRequest, Any, Any, asyncio.Future,
                                 asyncio.AbstractEventLoop]] = []
         self._inbox_lock = threading.Lock()
         # pump id -> (future, loop, caller's original request id)
@@ -68,16 +68,31 @@ class EnginePump:
         rolling batch via ``engine.submit_prefilled`` — no local prefill."""
         return await self._submit_all(pairs)
 
+    async def generate_streaming(
+        self, request: GenerationRequest, on_tokens,
+    ) -> GenerationResult:
+        """Like ``generate`` for one request, but ``on_tokens(tokens)`` is
+        invoked on THIS loop with each batch of fresh tokens as the engine
+        produces them (trimmed like the final result)."""
+        results = await self._submit_all([(request, None)],
+                                         on_tokens=on_tokens)
+        return results[0]
+
     async def _submit_all(
-        self, pairs: List[Tuple[GenerationRequest, Any]]
+        self, pairs: List[Tuple[GenerationRequest, Any]], on_tokens=None,
     ) -> List[GenerationResult]:
         self._ensure_thread()
         loop = asyncio.get_running_loop()
+        cb = None
+        if on_tokens is not None:
+            # engine thread -> caller's loop
+            def cb(tokens, _loop=loop, _cb=on_tokens):
+                _loop.call_soon_threadsafe(_cb, tokens)
         futs: List[asyncio.Future] = []
         with self._inbox_lock:
             for r, handoff in pairs:
                 fut: asyncio.Future = loop.create_future()
-                self._inbox.append((r, handoff, fut, loop))
+                self._inbox.append((r, handoff, cb, fut, loop))
                 futs.append(fut)
         self._wake.set()
         results = await asyncio.gather(*futs)
@@ -98,7 +113,7 @@ class EnginePump:
         exc = RuntimeError("engine pump shut down")
         with self._inbox_lock:
             pending, self._inbox = self._inbox, []
-        for _req, _handoff, fut, loop in pending:
+        for _req, _handoff, _cb, fut, loop in pending:
             loop.call_soon_threadsafe(self._set_exc, fut, exc)
         self._fail_all(exc)
 
@@ -145,16 +160,16 @@ class EnginePump:
     def _drain_inbox(self) -> int:
         with self._inbox_lock:
             batch, self._inbox = self._inbox, []
-        for req, handoff, fut, loop in batch:
+        for req, handoff, cb, fut, loop in batch:
             pump_id = f"pump-{id(self):x}-{len(self._futures)}-{time.monotonic_ns()}"
             original_id = req.request_id
             req.request_id = pump_id
             self._futures[pump_id] = (fut, loop, original_id)
             try:
                 if handoff is not None:
-                    self.engine.submit_prefilled(req, handoff)
+                    self.engine.submit_prefilled(req, handoff, on_tokens=cb)
                 else:
-                    self.engine.submit(req)
+                    self.engine.submit(req, on_tokens=cb)
             except Exception as e:
                 del self._futures[pump_id]
                 loop.call_soon_threadsafe(self._set_exc, fut, e)

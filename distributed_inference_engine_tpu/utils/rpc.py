@@ -155,6 +155,29 @@ class FramedRPCClient:
         (``OSError``/``asyncio.TimeoutError``/...) propagates for callers —
         router/LB — to turn into health signals.
         """
+        return await self._roundtrip(method, None, timeout, params)
+
+    async def call_stream(self, method: str, on_chunk: Callable[[Dict], None],
+                          *, timeout: Optional[float] = None,
+                          **params: Any) -> Any:
+        """Send one request, consume a stream of chunk frames, return the
+        final result.
+
+        The server interleaves ``{"stream": true, ...}`` frames (each passed
+        to ``on_chunk``) before the usual success/error envelope. ``timeout``
+        bounds each individual frame read — a live stream keeps resetting
+        it — not the total call.
+        """
+        return await self._roundtrip(method, on_chunk, timeout, params)
+
+    async def _roundtrip(self, method: str,
+                         on_chunk: Optional[Callable[[Dict], None]],
+                         timeout: Optional[float],
+                         params: Dict[str, Any]) -> Any:
+        """One shared request/response cycle for ``call`` and
+        ``call_stream`` — a single copy of the acquire/discard discipline
+        and envelope validation (two copies drifted once before; see the
+        module docstring)."""
         self._seq += 1
         msg = {"method": method, "id": f"{id(self):x}-{self._seq}", **params}
         effective = timeout if timeout is not None else self.timeout
@@ -162,9 +185,19 @@ class FramedRPCClient:
         conn = await self._acquire(effective)
         try:
             await write_frame(conn[1], msg)
-            response = await read_frame(
-                conn[0], max_frame=self.max_frame, timeout=effective,
-            )
+            while True:
+                frame = await read_frame(
+                    conn[0], max_frame=self.max_frame, timeout=effective,
+                )
+                if isinstance(frame, dict) and frame.get("stream"):
+                    if on_chunk is None:
+                        raise RPCError(
+                            f"unexpected stream frame from {method!r} — "
+                            "use call_stream for streaming methods")
+                    on_chunk(frame)
+                    continue
+                response = frame
+                break
         except BaseException:
             # BaseException: a cancelled caller must still return its slot
             # (a response may be in flight on the socket — discard it), or
@@ -179,6 +212,40 @@ class FramedRPCClient:
             raise RPCError(response.get("error", "unknown peer error"),
                            kind=str(response.get("error_kind", "")))
         return response.get("result")
+
+
+class ClientGone(Exception):
+    """The streaming client hung up mid-stream — not a handler failure."""
+
+
+async def relay_stream(fut: "asyncio.Future", queue: "asyncio.Queue",
+                       send) -> Any:
+    """Forward token chunks from ``queue`` to ``send`` until ``fut``
+    resolves, drain the stragglers, return the result.
+
+    The one copy of the getter/wait/drain/cancel relay both streaming
+    servers use (worker and coordinator — the cancellation/ordering logic
+    here is exactly the kind that drifts when duplicated). Safe because
+    chunk callbacks and the future resolution ride the same
+    ``call_soon_threadsafe`` FIFO: when ``fut`` is done, every chunk is
+    already queued.
+    """
+    try:
+        while True:
+            getter = asyncio.ensure_future(queue.get())
+            done, _ = await asyncio.wait(
+                {getter, fut}, return_when=asyncio.FIRST_COMPLETED)
+            if getter in done:
+                await send({"tokens": getter.result()})
+                continue
+            getter.cancel()
+            break
+        while not queue.empty():
+            await send({"tokens": queue.get_nowait()})
+        return await fut
+    except BaseException:
+        fut.cancel()
+        raise
 
 
 class FramedServerMixin:
@@ -196,9 +263,15 @@ class FramedServerMixin:
     - ``_timeout_error(method)`` — message for ``asyncio.TimeoutError``.
     - ``_on_handler_error(method, exc)`` — error accounting.
     - ``_after_dispatch(method, req_id, duration_s, response)`` — metrics.
+
+    Streaming: methods in ``_stream_methods`` get ``handler(msg, send)``
+    where ``await send(obj)`` writes a ``{"stream": true, "id": …}`` frame
+    ahead of the final envelope; the client consumes them with
+    ``FramedRPCClient.call_stream``.
     """
 
     _methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]]
+    _stream_methods: Dict[str, Callable[..., Awaitable[Any]]] = {}
     _conn_writers: set
     max_frame_bytes: int = 64 * 1024 * 1024
 
@@ -218,8 +291,17 @@ class FramedServerMixin:
                     await write_frame(writer, {"success": False,
                                                "error": f"bad frame: {e}"})
                     break
-                response = await self._dispatch(msg)
-                await write_frame(writer, response)
+                if (isinstance(msg, dict)
+                        and msg.get("method") in self._stream_methods):
+                    response = await self._dispatch_stream(msg, writer)
+                    if response is None:      # client hung up mid-stream
+                        break
+                else:
+                    response = await self._dispatch(msg)
+                try:
+                    await write_frame(writer, response)
+                except (ConnectionResetError, BrokenPipeError):
+                    break                     # client gone — nobody to tell
         finally:
             self._conn_writers.discard(writer)
             writer.close()
@@ -248,6 +330,49 @@ class FramedServerMixin:
             response = {"id": req_id, "success": False, **extra,
                         "error": self._timeout_error(method)}
         except Exception as e:  # fan any handler error back, keep serving
+            self._on_handler_error(method, e)
+            logger.warning("%s: %s failed: %s",
+                           type(self).__name__, method, e)
+            response = {"id": req_id, "success": False, **extra,
+                        "error": str(e)}
+            kind = getattr(e, "rpc_error_kind", "") or getattr(e, "kind", "")
+            if kind:
+                response["error_kind"] = kind
+        self._after_dispatch(method, req_id, time.perf_counter() - t0,
+                             response)
+        return response
+
+    async def _dispatch_stream(
+        self, msg: Dict[str, Any], writer: asyncio.StreamWriter
+    ) -> Optional[Dict[str, Any]]:
+        """Run a streaming handler: chunk frames on the wire as the
+        handler emits them, then the normal envelope. Returns None when
+        the CLIENT hung up mid-stream (routine for aborted generations —
+        not a handler failure, and there is nobody left to send an
+        envelope to); a downstream ConnectionError from the handler itself
+        still produces an error envelope."""
+        t0 = time.perf_counter()
+        method = msg["method"]
+        handler = self._stream_methods[method]
+        req_id = msg.get("id", "")
+        extra = self._envelope_extra()
+
+        async def send(obj: Dict[str, Any]) -> None:
+            try:
+                await write_frame(writer,
+                                  {"stream": True, "id": req_id, **obj})
+            except (ConnectionResetError, BrokenPipeError, OSError) as e:
+                raise ClientGone() from e
+
+        try:
+            result = await handler(msg, send)
+            response = {"id": req_id, "success": True, **extra,
+                        "result": result}
+        except ClientGone:
+            logger.info("%s: client disconnected mid-stream (%s)",
+                        type(self).__name__, method)
+            return None
+        except Exception as e:
             self._on_handler_error(method, e)
             logger.warning("%s: %s failed: %s",
                            type(self).__name__, method, e)

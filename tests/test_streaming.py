@@ -1,0 +1,251 @@
+"""Streaming + profiler tests: token chunks ride the framed connection
+ahead of the final result (multi-frame responses, ``utils/rpc.py``
+``_stream_methods``/``call_stream``), end-to-end through worker and
+coordinator; ``profile`` wraps jax.profiler trace capture (SURVEY.md §5
+tracing plan)."""
+
+import asyncio
+import os
+
+import pytest
+
+from distributed_inference_engine_tpu.api import (
+    Coordinator,
+    CoordinatorClient,
+    CoordinatorConfig,
+    CoordinatorServer,
+)
+from distributed_inference_engine_tpu.config import (
+    EngineConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import (
+    WorkerClient,
+    WorkerRPCError,
+    WorkerServer,
+)
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.llama import llama_spec
+
+SPEC = llama_spec("llama-tiny", max_seq_len=64)
+
+
+def _model_cfg(name="m", continuous=True):
+    meta = {"size": "llama-tiny", "page_size": 16, "num_pages": 64,
+            "attention_impl": "xla", "kv_dtype": "float32",
+            "decode_steps_per_call": 3}
+    if continuous:
+        meta["continuous"] = 1
+    return ModelConfig(name=name, architecture="llama", dtype="float32",
+                       max_seq_len=64, max_batch_size=4, metadata=meta)
+
+
+# -------------------------------------------------------------- engine level
+
+
+def test_engine_stream_callback_matches_result():
+    eng = ContinuousEngine(SPEC, config=EngineConfig(
+        max_slots=2, max_seq_len=64, page_size=16, num_pages=32,
+        decode_steps_per_call=3, attention_impl="xla"))
+    chunks = []
+    eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=10,
+                                 temperature=0.0, request_id="s"),
+               on_tokens=chunks.append)
+    res = eng.run_until_idle()[0]
+    streamed = [t for c in chunks for t in c]
+    assert streamed == res.tokens
+    assert len(chunks) >= 2                     # actually incremental
+
+
+def test_engine_stream_respects_eos_trim():
+    eng = ContinuousEngine(SPEC, config=EngineConfig(
+        max_slots=2, max_seq_len=64, page_size=16, num_pages=32,
+        decode_steps_per_call=4, attention_impl="xla"))
+    probe = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                            max_new_tokens=10,
+                                            temperature=0.0)])[0].tokens
+    eos = probe[3]
+    chunks = []
+    eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=10,
+                                 temperature=0.0, eos_id=eos),
+               on_tokens=chunks.append)
+    res = eng.run_until_idle()[0]
+    streamed = [t for c in chunks for t in c]
+    assert streamed == res.tokens               # no post-EOS leakage
+    assert res.finish_reason == "stop"
+
+
+# -------------------------------------------------------------- worker level
+
+
+@pytest.mark.asyncio
+async def test_worker_generate_stream_roundtrip():
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        await w.load_model_async(_model_cfg())
+        c = WorkerClient(*w.address, timeout=120.0)
+        chunks = []
+        req = GenerationRequest(prompt=[4, 5, 6], max_new_tokens=9,
+                                temperature=0.0, request_id="r")
+        res = await c.generate_stream("m", req, chunks.append)
+        assert [t for ch in chunks for t in ch] == res.tokens
+        assert len(res.tokens) == 9
+        assert len(chunks) >= 2
+        # matches non-streaming output
+        plain = await c.generate("m", [GenerationRequest(
+            prompt=[4, 5, 6], max_new_tokens=9, temperature=0.0)])
+        assert plain[0].tokens == res.tokens
+        await c.close()
+    finally:
+        await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_worker_stream_on_static_engine_is_informative():
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        await w.load_model_async(_model_cfg(continuous=False))
+        c = WorkerClient(*w.address, timeout=120.0)
+        with pytest.raises(WorkerRPCError, match="continuous"):
+            await c.generate_stream(
+                "m", GenerationRequest(prompt=[1], max_new_tokens=2),
+                lambda t: None)
+        # server keeps serving afterwards
+        assert (await c.ping())["worker_id"] == "w"
+        await c.close()
+    finally:
+        await w.stop()
+
+
+# --------------------------------------------------------- coordinator level
+
+
+@pytest.mark.asyncio
+async def test_coordinator_stream_end_to_end():
+    coord = Coordinator(CoordinatorConfig())
+    server = CoordinatorServer(coord, ServerConfig(port=0))
+    await server.start()
+    workers = []
+    try:
+        w = WorkerServer(ServerConfig(worker_id="w0", port=0))
+        host, port = await w.start()
+        workers.append(w)
+        coord.add_worker("w0", host, port)
+        await coord.deploy_model(_model_cfg())
+
+        chost, cport = server.address
+        client = CoordinatorClient(chost, cport)
+        chunks = []
+        out = await client.generate_stream(
+            "m", chunks.append, prompt=[7, 8, 9], max_new_tokens=8)
+        assert [t for c in chunks for t in c] == out["tokens"]
+        assert out["streamed"] is True
+        assert out["metadata"]["worker_id"] == "w0"
+        # plain path still works on the same connection
+        plain = await client.generate("m", prompt=[7, 8, 9],
+                                      max_new_tokens=8)
+        assert plain["tokens"] == out["tokens"]
+        await client.close()
+    finally:
+        await server.stop()
+        for w in workers:
+            await w.stop()
+
+
+# ------------------------------------------------------------------ profiler
+
+
+@pytest.mark.asyncio
+async def test_profile_start_stop_cycle(tmp_path):
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        c = WorkerClient(*w.address, timeout=60.0)
+        trace_dir = str(tmp_path / "trace")
+        out = await c.call("profile", action="start", trace_dir=trace_dir)
+        assert out["profiling"] is True
+        with pytest.raises(WorkerRPCError, match="already active"):
+            await c.call("profile", action="start")
+        # do some work under the trace
+        await w.load_model_async(_model_cfg())
+        await c.generate("m", [GenerationRequest(prompt=[1, 2],
+                                                 max_new_tokens=2)])
+        out = await c.call("profile", action="stop")
+        assert out["trace_dir"] == trace_dir
+        assert os.path.isdir(trace_dir)
+        with pytest.raises(WorkerRPCError, match="not active"):
+            await c.call("profile", action="stop")
+        await c.close()
+    finally:
+        await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_coordinator_stream_fails_over_before_first_chunk():
+    """A dead worker at dispatch time must not fail the stream — the
+    coordinator retries on an alternate as long as nothing has streamed
+    (review finding: streaming lacked the non-streaming path's failover)."""
+    coord = Coordinator(CoordinatorConfig())
+    await coord.start()
+    workers = []
+    try:
+        for i in range(2):
+            w = WorkerServer(ServerConfig(worker_id=f"w{i}", port=0))
+            host, port = await w.start()
+            workers.append(w)
+            coord.add_worker(f"w{i}", host, port)
+        await coord.deploy_model(_model_cfg())
+        await workers[0].stop()          # kill one replica
+
+        seen = []
+        for i in range(3):
+            out = await coord.submit_stream(
+                "m", prompt=[5, 6, 7 + i], max_new_tokens=4,
+                on_tokens=lambda t: seen.extend(t), key=f"k{i}")
+            assert len(out["tokens"]) == 4
+            assert out["metadata"]["worker_id"] == "w1"
+        assert len(seen) == 12
+    finally:
+        await coord.stop()
+        await workers[1].stop()
+
+
+@pytest.mark.asyncio
+async def test_client_disconnect_mid_stream_keeps_server_alive():
+    """A client hanging up mid-stream is routine (aborted generation) —
+    the worker must log-and-continue, not die or count an engine error."""
+    import asyncio as aio
+
+    from distributed_inference_engine_tpu.utils.framing import (
+        read_frame,
+        write_frame,
+    )
+
+    w = WorkerServer(ServerConfig(worker_id="w", port=0))
+    await w.start()
+    try:
+        await w.load_model_async(_model_cfg())
+        host, port = w.address
+        reader, writer = await aio.open_connection(host, port)
+        await write_frame(writer, {
+            "method": "generate_stream", "id": "x", "model": "m",
+            "request": {"prompt": [1, 2, 3], "max_new_tokens": 40,
+                        "temperature": 0.0},
+        })
+        # read one chunk frame, then slam the connection shut
+        frame = await read_frame(reader)
+        assert frame.get("stream") is True
+        writer.close()
+        # the server must still answer new connections and requests
+        await aio.sleep(0.5)
+        c = WorkerClient(host, port, timeout=120.0)
+        out = await c.generate("m", [GenerationRequest(
+            prompt=[1, 2], max_new_tokens=3)])
+        assert len(out[0].tokens) == 3
+        await c.close()
+    finally:
+        await w.stop()
