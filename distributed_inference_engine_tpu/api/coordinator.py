@@ -678,6 +678,111 @@ class Coordinator:
             out.append(d)
         return out
 
+    # -- state snapshot / resume (SURVEY.md §5 checkpoint row) --------------
+
+    def save_state(self, path: str) -> str:
+        """Snapshot the control plane to a JSON file: registry (shards,
+        versions, hashes — the reference's ``to_dict`` round-trip,
+        ``src/model_registry.py:192-249``, finally given file IO), fleet
+        membership, model configs and disaggregated pools."""
+        import json
+        import os
+        import tempfile
+
+        state = {
+            "version": 1,
+            "registry": self.registry.to_dict(),
+            "workers": {
+                wid: {"host": info.host, "port": info.port,
+                      "metadata": dict(info.metadata)}
+                for wid, info in self.router.workers.items()
+            },
+            "model_configs": {name: cfg.to_dict()
+                              for name, cfg in self._model_configs.items()},
+            "disaggregated": {
+                m: {"prefill": p.prefill_ids, "decode": p.decode_ids}
+                for m, p in self._disagg.items()
+            },
+        }
+        # atomic replace: a crash mid-write must not corrupt the snapshot
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(state, f, indent=2)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)          # don't litter on serialize failure
+            except OSError:
+                pass
+            raise
+        return path
+
+    async def restore_state(self, path: str, redeploy: bool = False,
+                            load_timeout_s: float = 600.0) -> int:
+        """Rebuild the control plane from a ``save_state`` snapshot.
+
+        Re-registers workers and the registry/pool metadata. With
+        ``redeploy=True`` it also pushes ``load_model`` to every worker
+        again — the recovery path when the fleet restarted empty (loads
+        are idempotent on workers that kept their engines). Redeploys are
+        BEST-EFFORT per model: a worker that isn't back yet is logged and
+        skipped (health probes + later deploys catch it up) rather than
+        aborting the whole restore. Returns the number of workers newly
+        registered.
+        """
+        import json
+
+        from ..cluster.registry import ModelRegistry
+
+        with open(path) as f:
+            state = json.load(f)
+        self.registry = ModelRegistry.from_dict(state["registry"])
+        self.router.registry = self.registry
+        added = 0
+        for wid, w in state.get("workers", {}).items():
+            if wid not in self.router.workers:
+                self.add_worker(wid, w["host"], int(w["port"]),
+                                **w.get("metadata", {}))
+                added += 1
+        self._model_configs = {
+            name: ModelConfig.from_dict(d)
+            for name, d in state.get("model_configs", {}).items()
+        }
+        self._disagg = {
+            m: _DisaggPool(prefill_ids=list(p["prefill"]),
+                           decode_ids=list(p["decode"]))
+            for m, p in state.get("disaggregated", {}).items()
+        }
+        if redeploy:
+            for name, cfg in self._model_configs.items():
+                pool = self._disagg.get(name)
+                try:
+                    if pool is not None:
+                        await self.deploy_model_disaggregated(
+                            cfg, pool.prefill_ids, pool.decode_ids,
+                            load_timeout_s=load_timeout_s)
+                        continue
+                    shards = self.registry.all_shards(cfg.name, cfg.version)
+                    # push engines back; shards already registered, so only
+                    # the load (idempotent on live workers) is repeated
+                    workers = ([s.worker_id for s in shards]
+                               or list(self.router.workers))
+                    for wid in workers:
+                        try:
+                            await self.router.client_for(wid).load_model(
+                                cfg, timeout=load_timeout_s)
+                        except _TRANSPORT_ERRORS as e:
+                            logger.warning(
+                                "restore: worker %s unreachable for %s "
+                                "(%s) — will catch up via health/deploy",
+                                wid, name, e)
+                except _TRANSPORT_ERRORS as e:
+                    logger.warning("restore: redeploy of %s failed (%s) — "
+                                   "continuing", name, e)
+        return added
+
     # -- introspection ------------------------------------------------------
 
     def get_stats(self) -> Dict[str, Any]:

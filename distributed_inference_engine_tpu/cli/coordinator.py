@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lb-strategy", default="round_robin",
                    choices=["round_robin", "least_connections", "random",
                             "least_latency"])
+    p.add_argument("--state", default="",
+                   help="state snapshot file: restored (with redeploy) at "
+                        "startup if present, saved after deploys and on "
+                        "shutdown")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -72,6 +76,17 @@ async def amain(args: argparse.Namespace) -> None:
     # is the readiness signal (same convention as cli/worker.py), so a script
     # that waits on it can generate immediately
     await coord.start()
+    import os
+
+    if args.state and os.path.isfile(args.state):
+        try:
+            n = await coord.restore_state(args.state, redeploy=True)
+            print(f"restored state from {args.state} ({n} workers added)",
+                  flush=True)
+        except Exception as e:
+            # a bad snapshot must not make restart WORSE than a fresh
+            # start — serve whatever the flags configure
+            print(f"state restore failed ({e}) — starting fresh", flush=True)
     for spec in args.worker:
         wid, whost, wport = parse_worker_arg(spec)
         coord.add_worker(wid, whost, wport)
@@ -79,6 +94,9 @@ async def amain(args: argparse.Namespace) -> None:
     for m in deploys:
         n = await coord.deploy_model(m)
         print(f"deployed {m.name} across {n} workers", flush=True)
+    if args.state:
+        coord.save_state(args.state)
+        print(f"state saved to {args.state}", flush=True)
     host, port = await server.start()
     print(f"coordinator listening on {host}:{port}", flush=True)
 
@@ -92,6 +110,9 @@ async def amain(args: argparse.Namespace) -> None:
     except NotImplementedError:
         pass
     await stop.wait()
+    if args.state:
+        coord.save_state(args.state)
+        print(f"state saved to {args.state}", flush=True)
     await server.stop()
 
 

@@ -74,7 +74,16 @@ def engine_from_config(cfg):
 
     spec = spec_for_architecture(arch, size=cfg.metadata.get("size", ""),
                                  max_seq_len=cfg.max_seq_len)
-    if cfg.path and os.path.isdir(cfg.path):
+    from ..utils.checkpoint import is_native_checkpoint, load_params, load_spec
+
+    if cfg.path and is_native_checkpoint(cfg.path):
+        # our own Orbax checkpoint dir (utils/checkpoint.py): spec sidecar
+        # + params tree, no HF mapping needed
+        ck_spec = load_spec(cfg.path)
+        spec = ck_spec.replace(max_seq_len=min(cfg.max_seq_len,
+                                               ck_spec.max_seq_len))
+        params = load_params(cfg.path)
+    elif cfg.path and os.path.isdir(cfg.path):
         hf_spec = spec_from_hf_config(cfg.path)
         spec = hf_spec.replace(max_seq_len=min(cfg.max_seq_len,
                                                hf_spec.max_seq_len))

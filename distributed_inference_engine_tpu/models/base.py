@@ -101,6 +101,11 @@ class ModelSpec:
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
+    def replace(self, **changes: Any) -> "ModelSpec":
+        """Frozen-dataclass update (``dataclasses.replace`` as a method —
+        the checkpoint/HF loaders cap ``max_seq_len`` through this)."""
+        return dataclasses.replace(self, **changes)
+
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelSpec":
         from ..config import build_dataclass
