@@ -80,7 +80,11 @@ def main() -> None:
         max_slots=BATCH,
         max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
         prefill_buckets=[PROMPT_LEN],
-        decode_steps_per_call=32,
+        # one device dispatch per chunk: over a tunnelled/remote device the
+        # fixed per-launch latency dominates, so default to one chunk per
+        # generation (the scan is on-device either way)
+        decode_steps_per_call=int(os.environ.get("BENCH_STEPS",
+                                                 str(NEW_TOKENS))),
     )
     t0 = time.perf_counter()
     engine = Engine(spec, config=cfg)

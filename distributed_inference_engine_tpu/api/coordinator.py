@@ -736,26 +736,40 @@ class Coordinator:
 
         from ..cluster.registry import ModelRegistry
 
+        # parse EVERYTHING before mutating self: a malformed snapshot must
+        # leave the coordinator exactly as it was (the CLI then truly
+        # "starts fresh" instead of serving a half-restored registry)
         with open(path) as f:
             state = json.load(f)
-        self.registry = ModelRegistry.from_dict(state["registry"])
-        self.router.registry = self.registry
-        added = 0
-        for wid, w in state.get("workers", {}).items():
-            if wid not in self.router.workers:
-                self.add_worker(wid, w["host"], int(w["port"]),
-                                **w.get("metadata", {}))
-                added += 1
-        self._model_configs = {
+        registry = ModelRegistry.from_dict(state["registry"])
+        workers = {wid: (w["host"], int(w["port"]),
+                         dict(w.get("metadata", {})))
+                   for wid, w in state.get("workers", {}).items()}
+        model_configs = {
             name: ModelConfig.from_dict(d)
             for name, d in state.get("model_configs", {}).items()
         }
-        self._disagg = {
+        disagg = {
             m: _DisaggPool(prefill_ids=list(p["prefill"]),
                            decode_ids=list(p["decode"]))
             for m, p in state.get("disaggregated", {}).items()
         }
+
+        self.registry = registry
+        self.router.registry = registry
+        added = 0
+        for wid, (host, port, meta) in workers.items():
+            if wid not in self.router.workers:
+                self.add_worker(wid, host, port, **meta)
+                added += 1
+        self._model_configs = model_configs
+        self._disagg = disagg
+
         if redeploy:
+            # best-effort per model: application errors (RPCError — e.g. a
+            # worker that kept a mismatched engine) AND transport errors
+            # are logged, never fatal to the rest of the restore
+            recoverable = (*_TRANSPORT_ERRORS, WorkerRPCError)
             for name, cfg in self._model_configs.items():
                 pool = self._disagg.get(name)
                 try:
@@ -767,18 +781,18 @@ class Coordinator:
                     shards = self.registry.all_shards(cfg.name, cfg.version)
                     # push engines back; shards already registered, so only
                     # the load (idempotent on live workers) is repeated
-                    workers = ([s.worker_id for s in shards]
+                    targets = ([s.worker_id for s in shards]
                                or list(self.router.workers))
-                    for wid in workers:
+                    for wid in targets:
                         try:
                             await self.router.client_for(wid).load_model(
                                 cfg, timeout=load_timeout_s)
-                        except _TRANSPORT_ERRORS as e:
+                        except recoverable as e:
                             logger.warning(
-                                "restore: worker %s unreachable for %s "
+                                "restore: load of %s on worker %s failed "
                                 "(%s) — will catch up via health/deploy",
-                                wid, name, e)
-                except _TRANSPORT_ERRORS as e:
+                                name, wid, e)
+                except recoverable as e:
                     logger.warning("restore: redeploy of %s failed (%s) — "
                                    "continuing", name, e)
         return added

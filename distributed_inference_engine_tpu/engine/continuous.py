@@ -149,16 +149,20 @@ class ContinuousEngine:
         spec_ = self.spec
 
         @jax.jit
-        def _prefill(params, tokens, seq_lens):
+        def _prefill(params, tokens, seq_lens, sampling, key):
             hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
             last = hidden[jnp.arange(tokens.shape[0]), seq_lens - 1]
-            return unembed(spec_, params, last), ks, vs
+            logits = unembed(spec_, params, last)
+            # sampled in-program: eager sampling is a dispatch chain that
+            # wrecks TTFT on remote/tunnelled devices
+            return sample_tokens(logits, sampling, key), ks, vs
 
         page_size = self.kv.page_size
 
         @partial(jax.jit, static_argnames=("n_ctx_pages",))
         def _prefill_suffix(params, tokens, suffix_lens, n_ctx, phys_pages,
-                            k_pages, v_pages, n_ctx_pages: int):
+                            k_pages, v_pages, sampling, key,
+                            n_ctx_pages: int):
             """Prefix-cache hit: prefill only the suffix, attending over
             the cached prefix gathered from its pages. One compiled program
             per (suffix bucket, ctx-pages bucket) pair."""
@@ -173,7 +177,8 @@ class ContinuousEngine:
                 spec_, params, tokens, suffix_lens, n_ctx, ck, cv
             )
             last = hidden[jnp.arange(tokens.shape[0]), suffix_lens - 1]
-            return unembed(spec_, params, last), ks, vs
+            logits = unembed(spec_, params, last)
+            return sample_tokens(logits, sampling, key), ks, vs
 
         fwd = partial(forward_decode_paged, attn_impl=self.attn_impl)
 
@@ -355,15 +360,22 @@ class ContinuousEngine:
             self._waiting.popleft()
             admitted += 1
             t0 = time.perf_counter()
+            sampling = SamplingParams(
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+            )
+            self._rng, k0 = jax.random.split(self._rng)
             if n_cached > 0:
-                logits = self._prefill_cached_suffix(prompt, slot, n_cached)
+                first_dev = self._prefill_cached_suffix(
+                    prompt, slot, n_cached, sampling, k0)
             else:
                 tb = _next_bucket(len(prompt), self.prefill_buckets)
                 tokens = np.zeros((1, tb), np.int32)
                 tokens[0, : len(prompt)] = prompt
                 seq_lens = jnp.asarray([len(prompt)], jnp.int32)
-                logits, ks, vs = self._prefill(
-                    self.params, jnp.asarray(tokens), seq_lens
+                first_dev, ks, vs = self._prefill(
+                    self.params, jnp.asarray(tokens), seq_lens, sampling, k0
                 )
                 kp, vp = write_prefill_pages(
                     self.kv.k_pages, self.kv.v_pages, ks, vs,
@@ -372,23 +384,18 @@ class ContinuousEngine:
                 self.kv.swap(kp, vp)
             if self.prefix_cache:
                 self.kv.register_prefix(slot, prompt)
-            sampling = SamplingParams(
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32),
-            )
-            self._rng, k0 = jax.random.split(self._rng)
-            first = int(np.asarray(sample_tokens(logits, sampling, k0))[0])
+            first = int(np.asarray(first_dev)[0])
 
             self._total_prompt_tokens += len(prompt)
             self._install_slot(req, slot, len(prompt), first, t0, on_tok)
         return admitted
 
-    def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int):
+    def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int,
+                               sampling, key):
         """Prefix-cache-hit admission: run the jitted suffix prefill over
         the uncached tail, write its KV at offset ``n_cached``, return the
-        last-position logits. ``n_cached`` is a whole number of pages and
-        < len(prompt) (``PagedKVCache.alloc_slot_prefix``)."""
+        sampled first token (device [1]). ``n_cached`` is a whole number
+        of pages and < len(prompt) (``PagedKVCache.alloc_slot_prefix``)."""
         suffix = prompt[n_cached:]
         tb = _next_bucket(len(suffix), self.prefill_buckets)
         tokens = np.zeros((1, tb), np.int32)
@@ -401,16 +408,17 @@ class ContinuousEngine:
             np.ascontiguousarray(self.kv._table[slot, :mpb]), jnp.int32
         )
         self._prefix_hit_admissions += 1
-        logits, ks, vs = self._prefill_suffix(
+        first_dev, ks, vs = self._prefill_suffix(
             self.params, jnp.asarray(tokens), suffix_lens, n_ctx, phys,
-            self.kv.k_pages, self.kv.v_pages, n_ctx_pages=mpb,
+            self.kv.k_pages, self.kv.v_pages, sampling, key,
+            n_ctx_pages=mpb,
         )
         kp, vp = write_prefill_pages(
             self.kv.k_pages, self.kv.v_pages, ks, vs,
             self.kv.page_table[slot: slot + 1], suffix_lens, start=n_ctx,
         )
         self.kv.swap(kp, vp)
-        return logits
+        return first_dev
 
     # ---------------------------------------------------------- streaming
 

@@ -143,16 +143,19 @@ class PrefillEngine:
         spec_ = self.spec
 
         @jax.jit
-        def _prefill(params, tokens, seq_lens):
+        def _prefill(params, tokens, seq_lens, sampling, key):
             hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
             b = tokens.shape[0]
             last = hidden[jnp.arange(b), seq_lens - 1]
             logits = unembed(spec_, params, last)
+            # first token sampled in-program (eager sampling costs a chain
+            # of device dispatches — ruinous on remote/tunnelled devices)
+            first = sample_tokens(logits, sampling, key)
             # [L, B, T, Hkv, Dh] -> [B, L, T, Hkv, Dh] so per-request slices
             # on the host are contiguous reads
             ks = jnp.swapaxes(ks, 0, 1).astype(self.kv_dtype)
             vs = jnp.swapaxes(vs, 0, 1).astype(self.kv_dtype)
-            return logits, ks, vs
+            return first, ks, vs
 
         self._prefill = _prefill
         self.prefill_stats = LatencyStats()
@@ -194,11 +197,12 @@ class PrefillEngine:
         )
 
         t0 = time.perf_counter()
-        logits, ks, vs = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens)
-        )
         self._rng, k0 = jax.random.split(self._rng)
-        first = np.asarray(sample_tokens(logits, sampling, k0))
+        first_dev, ks, vs = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+            sampling, k0,
+        )
+        first = np.asarray(first_dev)
         ks_np = np.asarray(jax.device_get(ks))     # [bb, L, tb, Hkv, Dh]
         vs_np = np.asarray(jax.device_get(vs))
         self.prefill_stats.add(time.perf_counter() - t0)

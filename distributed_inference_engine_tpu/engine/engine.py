@@ -100,12 +100,16 @@ class Engine:
         spec_ = self.spec
 
         @jax.jit
-        def _prefill(params, tokens, seq_lens):
+        def _prefill(params, tokens, seq_lens, sampling, key):
             hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
             b = tokens.shape[0]
             last = hidden[jnp.arange(b), seq_lens - 1]        # [B, D]
             logits = unembed(spec_, params, last)             # [B, V] fp32
-            return logits, ks, vs
+            # sample INSIDE the program: an eager sample after prefill is
+            # a chain of separate device dispatches — ruinous TTFT on a
+            # remote/tunnelled device
+            first = sample_tokens(logits, sampling, key)
+            return first, ks, vs
 
         @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(1, 2, 3, 4, 5, 6))
         def _decode_chunk(
@@ -196,11 +200,11 @@ class Engine:
         )
 
         t0 = time.perf_counter()
-        logits, ks, vs = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens)
-        )
         self._rng, k0 = jax.random.split(self._rng)
-        first = sample_tokens(logits, sampling, k0)     # [bb]
+        first, ks, vs = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+            sampling, k0,
+        )
 
         # ---- seed decode state; KV cache sized to the total-seq bucket
         L, Hkv, Dh = self.spec.n_layers, self.spec.n_kv_heads, self.spec.head_dim

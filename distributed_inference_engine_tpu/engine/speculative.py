@@ -102,12 +102,20 @@ class SpeculativeEngine:
         spec_t, spec_d, k = self.spec, self.draft_spec, self.k
 
         @jax.jit
-        def _prefill_both(pt, pd, tokens, seq_lens):
+        def _prefill_both(pt, pd, tokens, seq_lens, temps, key):
             hid_t, tks, tvs = forward_prefill(spec_t, pt, tokens, seq_lens)
             _hid_d, dks, dvs = forward_prefill(spec_d, pd, tokens, seq_lens)
             b = tokens.shape[0]
             last = hid_t[jnp.arange(b), seq_lens - 1]
-            return unembed(spec_t, pt, last), tks, tvs, dks, dvs
+            logits = unembed(spec_t, pt, last)
+            # first token sampled in-program (temperature only — the
+            # speculative engine's contract)
+            temp = jnp.maximum(temps, 1e-4)[:, None]
+            probs = jax.nn.softmax(logits / temp, axis=-1)
+            samp = jax.random.categorical(
+                key, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
+            first = jnp.where(temps <= 0.0, logits.argmax(-1), samp)
+            return first.astype(jnp.int32), tks, tvs, dks, dvs
 
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
         def _round(pt, pd, tck, tcv, dck, dcv,
@@ -270,17 +278,13 @@ class SpeculativeEngine:
             temps[i] = r.temperature
 
         t0 = time.perf_counter()
-        logits, tks, tvs, dks, dvs = self._prefill_both(
+        self._rng, k0 = jax.random.split(self._rng)
+        first_dev, tks, tvs, dks, dvs = self._prefill_both(
             self.params, self.draft_params,
             jnp.asarray(tokens), jnp.asarray(seq_lens),
+            jnp.asarray(temps), k0,
         )
-        # first token from the target prefill logits
-        temp = np.maximum(temps, 1e-4)
-        self._rng, k0 = jax.random.split(self._rng)
-        probs0 = jax.nn.softmax(jnp.asarray(logits) / temp[:, None], axis=-1)
-        samp0 = np.asarray(jax.random.categorical(
-            k0, jnp.log(jnp.maximum(probs0, 1e-30)), axis=-1))
-        first = np.where(temps <= 0.0, np.asarray(logits).argmax(-1), samp0)
+        first = np.asarray(first_dev)
 
         L_t = self.spec.n_layers
         L_d = self.draft_spec.n_layers
@@ -300,7 +304,7 @@ class SpeculativeEngine:
         hit = is_real & (first == eos) & (eos >= 0)
         active_np = is_real & ~hit & (produced_np < max_new_arr)
         out_tokens: List[List[int]] = [[int(first[i])] for i in range(n)]
-        jax.block_until_ready(logits)
+        jax.block_until_ready(first_dev)
         ttft = time.perf_counter() - t0
         self.prefill_stats.add(ttft)
 

@@ -78,7 +78,8 @@ def engine_from_config(cfg):
 
     if cfg.path and is_native_checkpoint(cfg.path):
         # our own Orbax checkpoint dir (utils/checkpoint.py): spec sidecar
-        # + params tree, no HF mapping needed
+        # + params tree, no HF mapping needed; the sidecar's dtype is
+        # authoritative (params are stored in it)
         ck_spec = load_spec(cfg.path)
         spec = ck_spec.replace(max_seq_len=min(cfg.max_seq_len,
                                                ck_spec.max_seq_len))
@@ -86,9 +87,14 @@ def engine_from_config(cfg):
     elif cfg.path and os.path.isdir(cfg.path):
         hf_spec = spec_from_hf_config(cfg.path)
         spec = hf_spec.replace(max_seq_len=min(cfg.max_seq_len,
-                                               hf_spec.max_seq_len))
+                                               hf_spec.max_seq_len),
+                               dtype=cfg.dtype or hf_spec.dtype)
         params = load_checkpoint(cfg.path, spec)
     else:
+        # honor the deploy config's compute dtype (previously silently
+        # ignored: a dtype=float32 deploy got the family default)
+        if cfg.dtype:
+            spec = spec.replace(dtype=cfg.dtype)
         params = None
     if cfg.quantized:
         # weight-only int8 (ops/quant.py): the registry's `quantized` flag,
@@ -136,6 +142,8 @@ def engine_from_config(cfg):
         else:
             d_spec = spec_for_architecture(arch, size=draft_size,
                                            max_seq_len=cfg.max_seq_len)
+            if cfg.dtype:
+                d_spec = d_spec.replace(dtype=cfg.dtype)
             d_params = None
         return SpeculativeEngine(spec, d_spec, params=params,
                                  draft_params=d_params, config=ecfg,

@@ -247,8 +247,11 @@ def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray
     w = params["tok_emb"].T if spec.tie_embeddings else params["lm_head"]
     if isinstance(w, QuantizedTensor):
         return matmul_any("...d,dv->...v", h.astype(jnp.float32), w)
-    return jnp.einsum("...d,dv->...v", h.astype(jnp.float32),
-                      w.astype(jnp.float32))
+    # keep the [D, V] projection in its storage dtype (bf16: half the HBM
+    # read of an fp32 upcast — this matmul streams the largest single
+    # weight every decode step) and accumulate in fp32 on the MXU
+    return jnp.einsum("...d,dv->...v", h.astype(w.dtype), w,
+                      preferred_element_type=jnp.float32)
 
 
 # ------------------------------------------------------------------ prefill

@@ -95,7 +95,7 @@ def suffix_attention(
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bkgij,bjkd->bikgd", probs.astype(v_all.dtype), v_all)
-    return out.reshape(b, ts, h, dh)
+    return out.reshape(b, ts, h, dh).astype(q.dtype)   # see cached_attention
 
 
 def cached_attention(
@@ -117,4 +117,7 @@ def cached_attention(
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bkgij,bjkd->bikgd", probs.astype(cache_v.dtype), cache_v)
-    return out.reshape(b, t, h, dh)
+    # query dtype out: the KV cache may be wider/narrower than the compute
+    # dtype (EngineConfig.kv_dtype), and the residual stream must not
+    # change dtype mid-scan (carry mismatch)
+    return out.reshape(b, t, h, dh).astype(q.dtype)

@@ -132,7 +132,8 @@ def quantize_params(spec, params: Dict[str, Any]) -> Dict[str, Any]:
             axes = _MOE_WEIGHTS[name]
         blocks[name] = quantize_weight(w, [a + 1 for a in axes])
     out["blocks"] = blocks
-    if not spec.tie_embeddings and "lm_head" in out:
+    if (not spec.tie_embeddings and "lm_head" in out
+            and not isinstance(out["lm_head"], QuantizedTensor)):
         out["lm_head"] = quantize_weight(out["lm_head"], (0,))
     return out
 

@@ -29,27 +29,11 @@ class SamplingParams(NamedTuple):
                    full(top_p, jnp.float32))
 
 
-def sample_tokens(
-    logits: jnp.ndarray,        # [B, V] fp32
-    params: SamplingParams,
-    key: jax.Array,
-) -> jnp.ndarray:
-    """Sample one token per row. Returns [B] int32.
-
-    Strategy composition: temperature scales, then top-k and top-p masks
-    (applied on the sorted distribution, so both are O(V log V) sorts that XLA
-    does fine on-device), then a Gumbel-max draw — which avoids materializing a
-    renormalized distribution. Greedy rows (temperature 0) take an argmax on
-    the *masked* logits, so greedy + top-k interact correctly.
-    """
-    b, v = logits.shape
-    logits = logits.astype(jnp.float32)
-
-    # ---- temperature FIRST (HF semantics): nucleus membership is judged on
-    # the tempered distribution, so high temperature widens the nucleus
-    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
-    scaled = logits / temp
-
+def _mask_topk_topp(scaled: jnp.ndarray, params: SamplingParams
+                    ) -> jnp.ndarray:
+    """Apply top-k and top-p masks to tempered logits (three O(V log V)
+    sorts — only worth running when some row actually uses the knobs)."""
+    b, v = scaled.shape
     # ---- top-k mask: keep the k highest (temperature preserves order, so
     # this is identical on raw or scaled logits)
     k = jnp.where(params.top_k <= 0, v, params.top_k)            # [B]
@@ -69,8 +53,41 @@ def sample_tokens(
     cum_excl = cum - probs_sorted
     keep_sorted = cum_excl < params.top_p[:, None]
     keep_topp = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+    return jnp.where(keep_topk & keep_topp, scaled, -jnp.inf)
 
-    masked = jnp.where(keep_topk & keep_topp, scaled, -jnp.inf)
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] fp32
+    params: SamplingParams,
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Sample one token per row. Returns [B] int32.
+
+    Strategy composition: temperature scales, then top-k and top-p masks,
+    then a Gumbel-max draw — which avoids materializing a renormalized
+    distribution. Greedy rows (temperature 0) take an argmax on the
+    *masked* logits, so greedy + top-k interact correctly.
+
+    The mask step costs three [B, V] sorts, so it hides behind a
+    ``lax.cond``: the common greedy / pure-temperature batch skips the
+    sorts entirely at runtime (one compiled program either way — the
+    branch predicate is data).
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    # ---- temperature FIRST (HF semantics): nucleus membership is judged on
+    # the tempered distribution, so high temperature widens the nucleus
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    needs_mask = jnp.any(params.top_k > 0) | jnp.any(params.top_p < 1.0)
+    masked = jax.lax.cond(
+        needs_mask,
+        lambda s: _mask_topk_topp(s, params),
+        lambda s: s,
+        scaled,
+    )
 
     # ---- Gumbel-max draw on the masked tempered logits
     gumbel = -jnp.log(-jnp.log(jax.random.uniform(key, (b, v), minval=1e-20, maxval=1.0)))

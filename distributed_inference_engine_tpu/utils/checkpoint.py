@@ -123,7 +123,7 @@ def save_train_state(path: str, spec, state: Dict[str, Any]) -> str:
     p.mkdir(parents=True, exist_ok=True)
     (p / SPEC_FILE).write_text(json.dumps(spec.to_dict(), indent=2))
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(p / "state", state, force=True)
+    ckptr.save(p / "state", _encode_tree(state), force=True)
     ckptr.close()
     return str(p)
 
@@ -135,7 +135,8 @@ def load_train_state(path: str, template: Optional[Any] = None) -> Any:
     ckptr = ocp.PyTreeCheckpointer()
     try:
         if template is not None:
-            return ckptr.restore(p, item=template)
-        return ckptr.restore(p)
+            return _decode_tree(ckptr.restore(p,
+                                              item=_encode_tree(template)))
+        return _decode_tree(ckptr.restore(p))
     finally:
         ckptr.close()

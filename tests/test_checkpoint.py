@@ -84,6 +84,47 @@ def test_quantized_checkpoint_roundtrip(tmp_path):
     assert len(out[0].tokens) == 4
 
 
+def test_quantized_checkpoint_with_quantized_flag(tmp_path):
+    """Regression: deploying a quantized checkpoint WITH quantized=True
+    (the natural config — the registry carries the flag) must not
+    re-quantize the restored QuantizedTensor lm_head."""
+    from distributed_inference_engine_tpu.ops.quant import quantize_params
+
+    params = quantize_params(SPEC, init_params(SPEC, jax.random.key(4)))
+    path = save_params(str(tmp_path / "qck"), SPEC, params)
+    eng = engine_from_config(ModelConfig(
+        name="q", architecture="llama", path=path, dtype="float32",
+        quantized=True, max_seq_len=64, max_batch_size=2,
+        metadata={"size": "llama-tiny"}))
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                          max_new_tokens=3)])
+    assert len(out[0].tokens) == 3
+
+
+def test_train_state_roundtrip(tmp_path):
+    """Training-state checkpoints resume bit-exact (including through the
+    quantized-sentinel encode path the params route uses)."""
+    import jax.numpy as jnp
+
+    from distributed_inference_engine_tpu.utils.checkpoint import (
+        load_train_state,
+        save_train_state,
+    )
+
+    state = {
+        "step": jnp.asarray(7),
+        "params": init_params(SPEC, jax.random.key(5)),
+        "mu": {"w": jnp.ones((4, 4), jnp.float32)},
+    }
+    path = save_train_state(str(tmp_path / "tck"), SPEC, state)
+    restored = load_train_state(path)
+    assert int(restored["step"]) == 7
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a, dtype="float32"),
+                                      np.asarray(b, dtype="float32"))
+
+
 def test_engine_from_hf_checkpoint_dir(tmp_path):
     """Regression: engine_from_config's HF-dir branch called a nonexistent
     ModelSpec.replace — a deploy with ModelConfig.path pointing at an HF
