@@ -211,6 +211,31 @@ class ContinuousEngine:
             )
             return carry, toks
 
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        def _install(lengths, last, active, produced, max_new, eos,
+                     temps, top_k, top_p, slot, vals):
+            """All per-slot state writes of one admission in ONE dispatch
+            (nine eager .at[].set calls would be nine round-trips — ruinous
+            on a remote/tunnelled device)."""
+            i = slot
+            return (
+                lengths.at[i].set(vals["prompt_len"]),
+                last.at[i].set(vals["first"]),
+                active.at[i].set(True),
+                produced.at[i].set(1),
+                max_new.at[i].set(vals["max_new"]),
+                eos.at[i].set(vals["eos"]),
+                temps.at[i].set(vals["temp"]),
+                top_k.at[i].set(vals["top_k"]),
+                top_p.at[i].set(vals["top_p"]),
+            )
+
+        # page-pool writes donate the pool: an un-donated eager scatter
+        # would materialise a full copy of the (possibly multi-GiB) pages
+        # on every admission
+        self._write_pages = jax.jit(write_prefill_pages,
+                                    donate_argnums=(0, 1))
+        self._install = _install
         self._prefill = _prefill
         self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
@@ -295,7 +320,7 @@ class ContinuousEngine:
             ks[:, 0, :prompt_len] = handoff.k
             vs[:, 0, :prompt_len] = handoff.v
             seq_lens = jnp.asarray([prompt_len], jnp.int32)
-            kp, vp = write_prefill_pages(
+            kp, vp = self._write_pages(
                 self.kv.k_pages, self.kv.v_pages,
                 jnp.asarray(ks), jnp.asarray(vs),
                 self.kv.page_table[slot: slot + 1], seq_lens,
@@ -326,16 +351,17 @@ class ContinuousEngine:
             self._finish(slot, "stop" if req.eos_id >= 0 and
                          first == req.eos_id else "length")
             return
-        i = slot
-        self._lengths = self._lengths.at[i].set(prompt_len)
-        self._last = self._last.at[i].set(first)
-        self._active = self._active.at[i].set(True)
-        self._produced = self._produced.at[i].set(1)
-        self._max_new = self._max_new.at[i].set(req.max_new_tokens)
-        self._eos = self._eos.at[i].set(req.eos_id)
-        self._temps = self._temps.at[i].set(req.temperature)
-        self._top_k = self._top_k.at[i].set(req.top_k)
-        self._top_p = self._top_p.at[i].set(req.top_p)
+        (self._lengths, self._last, self._active, self._produced,
+         self._max_new, self._eos, self._temps, self._top_k,
+         self._top_p) = self._install(
+            self._lengths, self._last, self._active, self._produced,
+            self._max_new, self._eos, self._temps, self._top_k,
+            self._top_p, slot,
+            {"prompt_len": prompt_len, "first": first,
+             "max_new": req.max_new_tokens, "eos": req.eos_id,
+             "temp": req.temperature, "top_k": req.top_k,
+             "top_p": req.top_p},
+        )
 
     def _try_admit(self) -> int:
         """Prefill waiting requests into free slots; returns #admitted."""
@@ -377,7 +403,7 @@ class ContinuousEngine:
                 first_dev, ks, vs = self._prefill(
                     self.params, jnp.asarray(tokens), seq_lens, sampling, k0
                 )
-                kp, vp = write_prefill_pages(
+                kp, vp = self._write_pages(
                     self.kv.k_pages, self.kv.v_pages, ks, vs,
                     self.kv.page_table[slot: slot + 1], seq_lens,
                 )
@@ -413,7 +439,7 @@ class ContinuousEngine:
             self.kv.k_pages, self.kv.v_pages, sampling, key,
             n_ctx_pages=mpb,
         )
-        kp, vp = write_prefill_pages(
+        kp, vp = self._write_pages(
             self.kv.k_pages, self.kv.v_pages, ks, vs,
             self.kv.page_table[slot: slot + 1], suffix_lens, start=n_ctx,
         )

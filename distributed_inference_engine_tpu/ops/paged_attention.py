@@ -25,11 +25,17 @@ Kernel design (flash-style online softmax over pages):
   page ids before the block DMA is issued — the gather lives in the DMA
   engine, not in compute.
 - Per grid step one K page and one V page are DMA'd to VMEM (double-buffered
-  by the Pallas pipeline across the sequential page axis), scores are computed
-  on the MXU in fp32, and VMEM scratch carries the running (max, sum, acc)
-  across pages of the same row.
-- GQA without materialization: Q is reshaped ``[n_kv, group, head_dim]`` and
-  contracted per kv-head, so grouped queries share one K/V load.
+  by the Pallas pipeline across the sequential page axis); VMEM scratch
+  carries the running (max, sum, acc) across pages of the same row.
+- All in-kernel tensors stay RANK-2 with the fused head·dim axis on lanes:
+  Mosaic rejects the "natural" batched-per-head ``dot_general`` and 3-D
+  reshapes for these shapes (found the hard way on hardware — interpret
+  mode happily accepts both). Per-head segment sums and broadcasts are
+  expressed as matmuls against constant 0/1 matrices, which lower cleanly
+  to the MXU; GQA expands K/V to query heads the same way.
+- Decode attention is HBM-bandwidth-bound; the kernel's job is DMAing only
+  live pages, not MXU utilisation. Precision is bf16-grade (Mosaic's fp32
+  matmul rounds operands through bf16 passes), matching bf16 serving.
 """
 
 from __future__ import annotations
@@ -88,25 +94,31 @@ def _paged_attn_kernel(
     # scalar prefetch
     page_table_ref,            # [B, MP] SMEM
     lengths_ref,               # [B] SMEM
-    # blocks
-    q_ref,                     # [1, H * Dh] VMEM
+    # blocks — q/out carry a singleton sublane axis: Mosaic requires the
+    # last two block dims to divide (8, 128) or EQUAL the array dims, and
+    # a (1, H·Dh) block over a (B, H·Dh) array satisfies neither (the
+    # interpret-mode tests can't catch this; only a real TPU lowers it)
+    q_ref,                     # [1, 1, H * Dh] VMEM
     k_ref,                     # [1, P, Hkv * Dh] VMEM (one physical page)
     v_ref,                     # [1, P, Hkv * Dh] VMEM
-    out_ref,                   # [1, H * Dh] VMEM
+    out_ref,                   # [1, 1, H * Dh] VMEM
     # scratch
-    m_scr,                     # [H, 128] f32
-    l_scr,                     # [H, 128] f32
-    acc_scr,                   # [H, Dh] f32
+    m_scr,                     # [1, H] f32 running max per head
+    l_scr,                     # [1, H] f32 running denominator
+    acc_scr,                   # [1, H * Dh] f32 running numerator
     *,
     n_kv_heads: int,
     head_dim: int,
     page_size: int,
+    n_heads: int,
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
     n_pages = pl.num_programs(1)
     length = lengths_ref[b]
     dh = head_dim
+    H = n_heads
+    g = H // n_kv_heads
 
     @pl.when(p == 0)
     def _init():
@@ -117,51 +129,61 @@ def _paged_attn_kernel(
     # pages past the live prefix contribute nothing; skip their FLOPs
     live = p * page_size < length
 
+    # constant 0/1 map, folded into the compiled kernel:
+    # S [H*Dh, H] segment-sums each head's Dh lanes; S.T broadcasts back
+    lane_head = lax.broadcasted_iota(jnp.int32, (H * dh, H), 0) // dh
+    head_idx = lax.broadcasted_iota(jnp.int32, (H * dh, H), 1)
+    seg = (lane_head == head_idx).astype(jnp.float32)
+
     @pl.when(live)
     def _page():
-        h_total = q_ref.shape[1] // dh
-        g = h_total // n_kv_heads
-        q = q_ref[0, :].reshape(n_kv_heads, g, dh)            # [Hkv, G, Dh]
-        k = k_ref[0].reshape(page_size, n_kv_heads, dh)       # [P, Hkv, Dh]
-        v = v_ref[0].reshape(page_size, n_kv_heads, dh)
-
-        # scores [Hkv, G, P]: contract Dh, batch over Hkv (MXU, fp32 accum)
-        scores = lax.dot_general(
-            q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        ) * (1.0 / (dh ** 0.5))
-
+        qf = q_ref[0, 0, :].astype(jnp.float32)[None, :]       # [1, H*Dh]
+        kf = k_ref[0].astype(jnp.float32)                      # [P, Hkv*Dh]
+        vf = v_ref[0].astype(jnp.float32)
+        if g > 1:
+            # GQA: replicate each kv head's Dh lanes across its query
+            # group with STATIC lane-slice concats (a dense 0/1 expander
+            # matmul would cost O(P·HkvDh·HDh) MACs and a VMEM constant
+            # that blows up at real GQA shapes, e.g. 16 MiB for 8B-class)
+            kf = jnp.concatenate(
+                [kf[:, (h // g) * dh:(h // g + 1) * dh] for h in range(H)],
+                axis=1)
+            vf = jnp.concatenate(
+                [vf[:, (h // g) * dh:(h // g + 1) * dh] for h in range(H)],
+                axis=1)
+        prod = kf * qf                                         # [P, H*Dh]
+        scores = jnp.dot(prod, seg,                            # [P, H]
+                         preferred_element_type=jnp.float32,
+                         precision=lax.Precision.HIGHEST)
+        scores = scores * (1.0 / (dh ** 0.5))
         tok = p * page_size + lax.broadcasted_iota(
-            jnp.int32, (n_kv_heads, g, page_size), 2
-        )
+            jnp.int32, (page_size, H), 0)
         scores = jnp.where(tok < length, scores, NEG_INF)
-        scores = scores.reshape(h_total, page_size)           # [H, P]
 
-        m_prev = m_scr[:, 0][:, None]                         # [H, 1]
-        l_prev = l_scr[:, 0][:, None]
-        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)                       # [H, 1]
-        probs = jnp.exp(scores - m_new)                       # [H, P]
-        l_new = l_prev * alpha + probs.sum(axis=-1, keepdims=True)
+        m_prev = m_scr[:]                                      # [1, H]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, scores.max(axis=0, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                        # [1, H]
+        probs = jnp.exp(scores - m_new[0][None, :])            # [P, H]
+        l_new = l_prev * alpha + probs.sum(axis=0, keepdims=True)
 
-        # pv [Hkv, G, Dh]: contract P, batch over Hkv
-        pv = lax.dot_general(
-            probs.reshape(n_kv_heads, g, page_size),
-            v.astype(jnp.float32),
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(h_total, dh)
-
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        pe = jnp.dot(probs, seg.T,                             # [P, H*Dh]
+                     preferred_element_type=jnp.float32,
+                     precision=lax.Precision.HIGHEST)
+        pv = (pe * vf).sum(axis=0, keepdims=True)              # [1, H*Dh]
+        alpha_e = jnp.dot(alpha, seg.T,
+                          preferred_element_type=jnp.float32,
+                          precision=lax.Precision.HIGHEST)
+        acc_scr[:] = acc_scr[:] * alpha_e + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
     @pl.when(p == n_pages - 1)
     def _finish():
-        h_total = q_ref.shape[1] // dh
-        l = jnp.maximum(l_scr[:, 0][:, None], 1e-30)          # [H, 1]
-        out = (acc_scr[:] / l).reshape(1, h_total * dh)
+        l = jnp.maximum(l_scr[:], 1e-30)                       # [1, H]
+        le = jnp.dot(l, seg.T, preferred_element_type=jnp.float32,
+                     precision=lax.Precision.HIGHEST)
+        out = (acc_scr[:] / le).reshape(1, 1, H * dh)
         out_ref[:] = out.astype(out_ref.dtype)
 
 
@@ -189,15 +211,18 @@ def paged_attention_pallas(
         num_scalar_prefetch=2,
         grid=(b, mp),
         in_specs=[
-            pl.BlockSpec((1, h * dh), lambda i, p, pt, ln: (i, 0)),
+            # q/out: (1, 1, H·Dh) blocks over a (B, 1, H·Dh) array — the
+            # trailing two block dims EQUAL the array dims, satisfying the
+            # Mosaic tiling rule for any batch size
+            pl.BlockSpec((1, 1, h * dh), lambda i, p, pt, ln: (i, 0, 0)),
             pl.BlockSpec((1, page_size, fused), lambda i, p, pt, ln: (pt[i, p], 0, 0)),
             pl.BlockSpec((1, page_size, fused), lambda i, p, pt, ln: (pt[i, p], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, h * dh), lambda i, p, pt, ln: (i, 0)),
+        out_specs=pl.BlockSpec((1, 1, h * dh), lambda i, p, pt, ln: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((h, dh), jnp.float32),
+            pltpu.VMEM((1, h), jnp.float32),
+            pltpu.VMEM((1, h), jnp.float32),
+            pltpu.VMEM((1, h * dh), jnp.float32),
         ],
     )
     kernel = functools.partial(
@@ -205,13 +230,14 @@ def paged_attention_pallas(
         n_kv_heads=n_kv_heads,
         head_dim=dh,
         page_size=page_size,
+        n_heads=h,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h * dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, h * dh), q.dtype),
         interpret=interpret,
-    )(page_table, lengths, q.reshape(b, h * dh), k_pages, v_pages)
+    )(page_table, lengths, q.reshape(b, 1, h * dh), k_pages, v_pages)
     return out.reshape(b, h, dh)
 
 
