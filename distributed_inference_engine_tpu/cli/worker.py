@@ -69,6 +69,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model to preload (repeatable)")
     p.add_argument("--config", default="",
                    help="config file (.json/.toml/.yaml) — overrides flags")
+    p.add_argument("--multihost", action="store_true",
+                   help="join the jax.distributed runtime before loading "
+                        "models (TPU pod slices: run one worker per host; "
+                        "Cloud TPU auto-discovers the coordinator)")
+    p.add_argument("--coordinator-address", default="",
+                   help="explicit jax.distributed coordinator (host:port) "
+                        "for bring-your-own clusters")
+    p.add_argument("--num-processes", type=int, default=0)
+    p.add_argument("--process-id", type=int, default=-1)
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -82,6 +91,18 @@ async def amain(args: argparse.Namespace) -> None:
         server_cfg = ServerConfig(worker_id=args.worker_id, host=args.host,
                                   port=args.port)
         models = [parse_model_arg(m) for m in args.model]
+
+    if args.multihost:
+        # pod-slice mode: join jax.distributed FIRST so engine init sees
+        # the global device set (parallel/multihost.py)
+        from ..parallel.multihost import initialize_multihost
+
+        idx = initialize_multihost(
+            coordinator_address=args.coordinator_address or None,
+            num_processes=args.num_processes or None,
+            process_id=args.process_id if args.process_id >= 0 else None,
+        )
+        print(f"multihost: process {idx}", flush=True)
 
     worker = WorkerServer(server_cfg)
     # preload BEFORE announcing the address: the "listening" line is the

@@ -1,0 +1,53 @@
+"""Multi-host bootstrap tests (parallel/multihost.py). The distributed
+runtime is joined in a SUBPROCESS — ``jax.distributed.initialize`` is
+process-global state the shared test process must not absorb."""
+
+import subprocess
+import sys
+
+from distributed_inference_engine_tpu.config import MeshConfig
+from distributed_inference_engine_tpu.parallel.multihost import global_mesh
+
+
+def test_global_mesh_spans_all_devices():
+    import jax
+
+    mesh = global_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    assert mesh.devices.size == 8
+    assert set(mesh.axis_names) >= {"dp", "sp", "tp"}
+    # explicit device list (tests / partial slices)
+    mesh2 = global_mesh(MeshConfig(tp=4), devices=jax.devices()[:4])
+    assert mesh2.devices.size == 4
+
+
+def test_initialize_multihost_single_process():
+    code = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import socket
+
+from distributed_inference_engine_tpu.config import MeshConfig
+from distributed_inference_engine_tpu.parallel.multihost import (
+    global_mesh, initialize_multihost, is_primary)
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+idx = initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=1, process_id=0)
+assert idx == 0
+assert initialize_multihost() == 0          # idempotent
+assert is_primary()
+assert jax.process_count() == 1
+mesh = global_mesh(MeshConfig(dp=2, tp=4))
+assert mesh.devices.size == 8
+print("MULTIHOST-OK")
+"""
+    import pathlib
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=240, cwd=repo_root)
+    assert "MULTIHOST-OK" in out.stdout, out.stderr[-2000:]
