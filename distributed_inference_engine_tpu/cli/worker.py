@@ -87,20 +87,30 @@ async def amain(args: argparse.Namespace) -> None:
         cfg = load_config(args.config)
         server_cfg = cfg.server
         models = cfg.models
+        mh = cfg.multihost
+        # flags still force multihost on top of a config file
+        mh_enabled = mh.enabled or args.multihost
+        mh_addr = args.coordinator_address or mh.coordinator_address
+        mh_np = args.num_processes or mh.num_processes
+        mh_pid = args.process_id if args.process_id >= 0 else mh.process_id
     else:
         server_cfg = ServerConfig(worker_id=args.worker_id, host=args.host,
                                   port=args.port)
         models = [parse_model_arg(m) for m in args.model]
+        mh_enabled = args.multihost
+        mh_addr = args.coordinator_address
+        mh_np = args.num_processes
+        mh_pid = args.process_id
 
-    if args.multihost:
+    if mh_enabled:
         # pod-slice mode: join jax.distributed FIRST so engine init sees
         # the global device set (parallel/multihost.py)
         from ..parallel.multihost import initialize_multihost
 
         idx = initialize_multihost(
-            coordinator_address=args.coordinator_address or None,
-            num_processes=args.num_processes or None,
-            process_id=args.process_id if args.process_id >= 0 else None,
+            coordinator_address=mh_addr or None,
+            num_processes=mh_np or None,
+            process_id=mh_pid if mh_pid >= 0 else None,
         )
         print(f"multihost: process {idx}", flush=True)
 

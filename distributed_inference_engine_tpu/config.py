@@ -138,6 +138,17 @@ class ServerConfig:
 
 
 @dataclass
+class MultihostConfig:
+    """jax.distributed bootstrap for pod slices (parallel/multihost.py);
+    empty/default fields mean Cloud-TPU env auto-discovery."""
+
+    enabled: bool = False
+    coordinator_address: str = ""     # host:port; "" = auto-discover
+    num_processes: int = 0            # 0 = auto
+    process_id: int = -1              # -1 = auto
+
+
+@dataclass
 class Config:
     """Root config: engine/mesh/serving/cluster sections (SURVEY.md §5
     config-system plan)."""
@@ -149,6 +160,7 @@ class Config:
     cache: CacheConfig = field(default_factory=CacheConfig)
     health: HealthConfig = field(default_factory=HealthConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
+    multihost: MultihostConfig = field(default_factory=MultihostConfig)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -165,6 +177,7 @@ def config_from_dict(d: Dict[str, Any]) -> Config:
         ("cache", CacheConfig),
         ("health", HealthConfig),
         ("server", ServerConfig),
+        ("multihost", MultihostConfig),
     ):
         if section in d:
             setattr(cfg, section, build_dataclass(cls, d[section]))

@@ -91,6 +91,8 @@ class ContinuousEngine:
         config: Optional[EngineConfig] = None,
         seed: int = 0,
         shard_fn=None,
+        kv_sharding=None,   # NamedSharding for the page pools (tp serving;
+                            # parallel.sharding.ModelShardings.paged_kv)
     ) -> None:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
@@ -107,7 +109,7 @@ class ContinuousEngine:
         self.kv = PagedKVCache(
             spec, max_slots=cfg.max_slots, page_size=cfg.page_size,
             num_pages=cfg.num_pages, max_seq_len=max_seq,
-            dtype=cfg.kv_dtype,
+            dtype=cfg.kv_dtype, sharding=kv_sharding,
         )
         self.prefill_buckets = sorted(
             {b for b in cfg.prefill_buckets if b < max_seq} | {max_seq}

@@ -31,6 +31,7 @@ import collections
 import hashlib
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -52,6 +53,7 @@ class PagedKVCache:
         num_pages: int = 512,
         max_seq_len: Optional[int] = None,
         dtype: Optional[str] = None,
+        sharding=None,   # NamedSharding over [L, N, P, fused] (tp serving)
     ) -> None:
         fused = spec.n_kv_heads * spec.head_dim
         if fused % 128:
@@ -68,8 +70,19 @@ class PagedKVCache:
         self.dtype = jnp.dtype(dtype) if dtype else spec.jnp_dtype
 
         shape = (spec.n_layers, num_pages, page_size, fused)
-        self.k_pages = jnp.zeros(shape, dtype=self.dtype)
-        self.v_pages = jnp.zeros(shape, dtype=self.dtype)
+        if sharding is not None:
+            # tp serving: each chip's pool holds only its heads' lanes.
+            # Allocate DIRECTLY sharded — zeros-then-device_put would
+            # materialise the global pool on one chip first (OOM at exactly
+            # the large-pool sizes tp serving exists for) and cannot target
+            # non-addressable devices on a multi-host mesh
+            alloc = jax.jit(lambda: jnp.zeros(shape, dtype=self.dtype),
+                            out_shardings=sharding)
+            self.k_pages = alloc()
+            self.v_pages = alloc()
+        else:
+            self.k_pages = jnp.zeros(shape, dtype=self.dtype)
+            self.v_pages = jnp.zeros(shape, dtype=self.dtype)
 
         self._free: List[int] = list(range(num_pages))
         self._slot_pages: Dict[int, List[int]] = {}   # slot -> physical pages

@@ -91,6 +91,14 @@ def kv_cache_pspec() -> P:
     return P(None, "dp", None, "tp", None)
 
 
+def paged_kv_pspec() -> P:
+    """[L, num_pages, page_size, Hkv*Dh] page pools: the fused head·dim
+    axis shards over tp (head boundaries align because tp must divide
+    Hkv), so each chip's pool holds only its heads' pages — per-chip KV
+    HBM drops linearly with tp, same as the contiguous layout."""
+    return P(None, None, None, "tp")
+
+
 def batch_pspec() -> P:
     """[B, T] token batches: batch over dp, sequence over sp."""
     return P("dp", "sp")
@@ -103,6 +111,7 @@ class ModelShardings:
     mesh: Mesh
     params: Any              # pytree of NamedSharding
     kv: NamedSharding
+    paged_kv: NamedSharding
     batch: NamedSharding
     replicated: NamedSharding
 
@@ -117,6 +126,7 @@ class ModelShardings:
             mesh=mesh,
             params=named,
             kv=NamedSharding(mesh, kv_cache_pspec()),
+            paged_kv=NamedSharding(mesh, paged_kv_pspec()),
             batch=NamedSharding(mesh, batch_pspec()),
             replicated=NamedSharding(mesh, REPLICATED),
         )
