@@ -68,7 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="K=V[,K=V...]",
                    help="model to preload (repeatable)")
     p.add_argument("--config", default="",
-                   help="config file (.json/.toml/.yaml) — overrides flags")
+                   help="config file (.json/.toml/.yaml): server/model "
+                        "settings come from the file; explicit multihost "
+                        "flags still override its multihost section")
     p.add_argument("--multihost", action="store_true",
                    help="join the jax.distributed runtime before loading "
                         "models (TPU pod slices: run one worker per host; "

@@ -215,21 +215,24 @@ class ContinuousEngine:
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
         def _install(lengths, last, active, produced, max_new, eos,
-                     temps, top_k, top_p, slot, vals):
-            """All per-slot state writes of one admission in ONE dispatch
-            (nine eager .at[].set calls would be nine round-trips — ruinous
-            on a remote/tunnelled device)."""
-            i = slot
+                     temps, top_k, top_p, slots, vals):
+            """All per-slot state writes of a WHOLE admission round in ONE
+            dispatch (eager .at[].set chains are device round-trips —
+            ruinous on remote/tunnelled devices). ``slots`` is a padded
+            int32 vector; pad entries hold ``max_slots`` and fall out of
+            range (``mode="drop"``)."""
+            i = slots
+            kw = dict(mode="drop")
             return (
-                lengths.at[i].set(vals["prompt_len"]),
-                last.at[i].set(vals["first"]),
-                active.at[i].set(True),
-                produced.at[i].set(1),
-                max_new.at[i].set(vals["max_new"]),
-                eos.at[i].set(vals["eos"]),
-                temps.at[i].set(vals["temp"]),
-                top_k.at[i].set(vals["top_k"]),
-                top_p.at[i].set(vals["top_p"]),
+                lengths.at[i].set(vals["prompt_len"], **kw),
+                last.at[i].set(vals["first"], **kw),
+                active.at[i].set(True, **kw),
+                produced.at[i].set(1, **kw),
+                max_new.at[i].set(vals["max_new"], **kw),
+                eos.at[i].set(vals["eos"], **kw),
+                temps.at[i].set(vals["temp"], **kw),
+                top_k.at[i].set(vals["top_k"], **kw),
+                top_p.at[i].set(vals["top_p"], **kw),
             )
 
         # page-pool writes donate the pool: an un-donated eager scatter
@@ -251,6 +254,7 @@ class ContinuousEngine:
         self._admission_denied = 0
         self._capacity_finishes = 0
         self._steps = 0
+        self._prefill_calls = 0     # batched-admission dispatches
 
     # ------------------------------------------------------------- submit
 
@@ -333,12 +337,11 @@ class ContinuousEngine:
                                t0, on_tok)
         return admitted
 
-    def _install_slot(self, req: GenerationRequest, slot: int,
-                      prompt_len: int, first: int, t0: float,
-                      on_tokens=None) -> None:
-        """Shared tail of admission: host bookkeeping + device slot state
-        for a sequence whose prompt KV is in pages and whose first token is
-        ``first``."""
+    def _register_slot_host(self, req: GenerationRequest, slot: int,
+                            prompt_len: int, first: int, t0: float,
+                            on_tokens=None) -> bool:
+        """Host bookkeeping of one admission; returns True when the slot
+        stays live (i.e. needs its device state installed)."""
         state = _Slot(req, slot, prompt_len, on_tokens)
         state.tokens.append(first)
         state.produced = 1
@@ -352,33 +355,88 @@ class ContinuousEngine:
         if done:
             self._finish(slot, "stop" if req.eos_id >= 0 and
                          first == req.eos_id else "length")
+            return False
+        return True
+
+    def _install_device(self, rows: List[Dict[str, Any]]) -> None:
+        """Install device state for a round of admissions in one dispatch;
+        ``rows`` entries carry slot + per-slot fields. Padded to a pow2
+        bucket with out-of-range slots (dropped by the scatter)."""
+        if not rows:
             return
+        bb = 1 << (len(rows) - 1).bit_length()
+        slots = np.full((bb,), self.max_slots, np.int32)   # pad -> dropped
+        f = {k: np.zeros((bb,), dt) for k, dt in (
+            ("prompt_len", np.int32), ("first", np.int32),
+            ("max_new", np.int32), ("eos", np.int32),
+            ("temp", np.float32), ("top_k", np.int32),
+            ("top_p", np.float32))}
+        for i, r in enumerate(rows):
+            slots[i] = r["slot"]
+            for k in f:
+                f[k][i] = r[k]
         (self._lengths, self._last, self._active, self._produced,
          self._max_new, self._eos, self._temps, self._top_k,
          self._top_p) = self._install(
             self._lengths, self._last, self._active, self._produced,
             self._max_new, self._eos, self._temps, self._top_k,
-            self._top_p, slot,
-            {"prompt_len": prompt_len, "first": first,
-             "max_new": req.max_new_tokens, "eos": req.eos_id,
-             "temp": req.temperature, "top_k": req.top_k,
-             "top_p": req.top_p},
+            self._top_p, jnp.asarray(slots),
+            {k: jnp.asarray(v) for k, v in f.items()},
         )
 
+    @staticmethod
+    def _slot_row(req: GenerationRequest, slot: int, prompt_len: int,
+                  first: int) -> Dict[str, Any]:
+        return {"slot": slot, "prompt_len": prompt_len, "first": first,
+                "max_new": req.max_new_tokens, "eos": req.eos_id,
+                "temp": req.temperature, "top_k": req.top_k,
+                "top_p": req.top_p}
+
+    def _install_slot(self, req: GenerationRequest, slot: int,
+                      prompt_len: int, first: int, t0: float,
+                      on_tokens=None) -> None:
+        """Single-admission tail (suffix / disaggregated paths); batched
+        admissions go through ``_admit_batch``."""
+        if self._register_slot_host(req, slot, prompt_len, first, t0,
+                                    on_tokens):
+            self._install_device(
+                [self._slot_row(req, slot, prompt_len, first)])
+
     def _try_admit(self) -> int:
-        """Prefill waiting requests into free slots; returns #admitted."""
+        """Prefill waiting requests into free slots; returns #admitted.
+
+        Cache-miss admissions are BATCHED: every admittable waiting request
+        shares one prefill program, one page write, and one state install
+        (N serial admissions are N× the fixed dispatch cost — the dominant
+        admission cost on remote/tunnelled devices). Prefix-cache hits run
+        their suffix programs individually (per-hit context shapes).
+        """
         admitted = self._admit_prefilled()
+        batch: List[Tuple[GenerationRequest, Any, int, List[int]]] = []
+        # first-page hashes the CURRENT batch will register post-prefill:
+        # a same-round request sharing one must wait for the flush (then
+        # its alloc sees the registered pages and takes the suffix path)
+        pending_hashes: set = set()
         while self._waiting:
             req, on_tok = self._waiting[0]
             # overlong prompts keep their tail (sliding-window truncation,
             # same policy as Engine.generate); cap leaves ≥1 decode position
             prompt = req.prompt[-(self.max_seq_len - 1):]
             if self.prefix_cache:
+                h1 = self.kv.first_page_hash(prompt)
+                if batch and h1 is not None and h1 in pending_hashes:
+                    self._admit_batch(batch)       # registers their pages
+                    batch = []
+                    pending_hashes.clear()
                 got = self.kv.alloc_slot_prefix(prompt)
                 if got is None:
                     self._admission_denied += 1
                     break
                 slot, n_cached = got
+                if n_cached == 0:
+                    hr = self.kv.first_page_hash(prompt, registerable=True)
+                    if hr is not None:
+                        pending_hashes.add(hr)
             else:
                 slot = self.kv.alloc_slot(len(prompt))
                 n_cached = 0
@@ -387,36 +445,80 @@ class ContinuousEngine:
                     break
             self._waiting.popleft()
             admitted += 1
-            t0 = time.perf_counter()
-            sampling = SamplingParams(
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32),
-            )
-            self._rng, k0 = jax.random.split(self._rng)
             if n_cached > 0:
+                t0 = time.perf_counter()
+                sampling = SamplingParams(
+                    jnp.asarray([req.temperature], jnp.float32),
+                    jnp.asarray([req.top_k], jnp.int32),
+                    jnp.asarray([req.top_p], jnp.float32),
+                )
+                self._rng, k0 = jax.random.split(self._rng)
                 first_dev = self._prefill_cached_suffix(
                     prompt, slot, n_cached, sampling, k0)
+                self.kv.register_prefix(slot, prompt)
+                first = int(np.asarray(first_dev)[0])
+                self._total_prompt_tokens += len(prompt)
+                self._install_slot(req, slot, len(prompt), first, t0, on_tok)
             else:
-                tb = _next_bucket(len(prompt), self.prefill_buckets)
-                tokens = np.zeros((1, tb), np.int32)
-                tokens[0, : len(prompt)] = prompt
-                seq_lens = jnp.asarray([len(prompt)], jnp.int32)
-                first_dev, ks, vs = self._prefill(
-                    self.params, jnp.asarray(tokens), seq_lens, sampling, k0
-                )
-                kp, vp = self._write_pages(
-                    self.kv.k_pages, self.kv.v_pages, ks, vs,
-                    self.kv.page_table[slot: slot + 1], seq_lens,
-                )
-                self.kv.swap(kp, vp)
+                batch.append((req, on_tok, slot, prompt))
+                if len(batch) >= self.max_slots:
+                    self._admit_batch(batch)
+                    batch = []
+                    # flushed batches registered their pages — stale hashes
+                    # here would force spurious flushes later this round
+                    pending_hashes.clear()
+        if batch:
+            self._admit_batch(batch)
+        return admitted
+
+    def _admit_batch(self, batch) -> None:
+        """One prefill + one page write + one install for N cache-miss
+        admissions. Rows are padded to a power-of-two batch bucket; pad
+        rows carry seq_len 0, so neither the page write nor the install
+        touches anything (their page-table row points at page 0 but the
+        valid mask drops every position)."""
+        t0 = time.perf_counter()
+        self._prefill_calls += 1
+        n = len(batch)
+        bb = 1 << (n - 1).bit_length()                     # pow2 bucket
+        tb = _next_bucket(max(len(p) for _, _, _, p in batch),
+                          self.prefill_buckets)
+        tokens = np.zeros((bb, tb), np.int32)
+        seq_lens = np.zeros((bb,), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        top_k = np.zeros((bb,), np.int32)
+        top_p = np.ones((bb,), np.float32)
+        table_rows = np.zeros((bb, self.kv.max_pages_per_seq), np.int32)
+        for i, (req, _cb, slot, prompt) in enumerate(batch):
+            tokens[i, : len(prompt)] = prompt
+            seq_lens[i] = len(prompt)
+            temps[i] = req.temperature
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+            table_rows[i] = self.kv._table[slot]
+        sampling = SamplingParams(jnp.asarray(temps), jnp.asarray(top_k),
+                                  jnp.asarray(top_p))
+        self._rng, k0 = jax.random.split(self._rng)
+        seq_dev = jnp.asarray(seq_lens)
+        first_dev, ks, vs = self._prefill(
+            self.params, jnp.asarray(tokens), seq_dev, sampling, k0
+        )
+        kp, vp = self._write_pages(
+            self.kv.k_pages, self.kv.v_pages, ks, vs,
+            jnp.asarray(table_rows), seq_dev,
+        )
+        self.kv.swap(kp, vp)
+        firsts = np.asarray(first_dev)
+        rows: List[Dict[str, Any]] = []
+        for i, (req, cb, slot, prompt) in enumerate(batch):
             if self.prefix_cache:
                 self.kv.register_prefix(slot, prompt)
-            first = int(np.asarray(first_dev)[0])
-
             self._total_prompt_tokens += len(prompt)
-            self._install_slot(req, slot, len(prompt), first, t0, on_tok)
-        return admitted
+            first = int(firsts[i])
+            if self._register_slot_host(req, slot, len(prompt), first,
+                                        t0, cb):
+                rows.append(self._slot_row(req, slot, len(prompt), first))
+        self._install_device(rows)
 
     def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int,
                                sampling, key):
@@ -610,6 +712,7 @@ class ContinuousEngine:
             "admission_denied": self._admission_denied,
             "capacity_finishes": self._capacity_finishes,
             "engine_steps": self._steps,
+            "prefill_calls": self._prefill_calls,
             "prefix_hit_admissions": self._prefix_hit_admissions,
             "prefill": self.prefill_stats.snapshot(),
             "decode_chunk": self.chunk_stats.snapshot(),

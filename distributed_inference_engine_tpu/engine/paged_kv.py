@@ -256,6 +256,24 @@ class PagedKVCache:
             out.append(h)
         return out
 
+    def first_page_hash(self, tokens,
+                        registerable: bool = False) -> Optional[bytes]:
+        """Chain hash of the prompt's first full page, or None when the
+        prompt has none. Any prefix sharing between two prompts implies
+        sharing this hash — the batched-admission loop uses it to detect
+        intra-round overlap cheaply.
+
+        ``registerable=True`` uses the register bound (``len // P``: the
+        pages ``register_prefix`` WILL index) — the adding side of the
+        dedup set; the default uses the match bound (``(len-1) // P``:
+        what ``alloc_slot_prefix`` can reuse) — the checking side.
+        """
+        n_full = (len(tokens) if registerable
+                  else len(tokens) - 1) // self.page_size
+        if n_full < 1:
+            return None
+        return self._page_hashes(tokens, 1)[0]
+
     def alloc_slot_prefix(self, tokens) -> Optional[Tuple[int, int]]:
         """Claim a slot for a prompt, reusing cached KV pages for its
         longest indexed full-page prefix. Returns (slot, n_cached_tokens),

@@ -125,3 +125,30 @@ def test_metrics_shape():
     for k in ("total_requests", "waiting", "live_slots", "kv",
               "prefill", "decode_chunk", "attn_impl"):
         assert k in m, k
+
+
+def test_batched_admission_single_prefill_dispatch():
+    """N simultaneous cache-miss admissions share ONE prefill program
+    call (serial per-request admission pays the fixed dispatch cost N
+    times — the dominant admission cost on remote devices)."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+    from distributed_inference_engine_tpu.engine.types import GenerationRequest
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+
+    spec = llama_spec("llama-tiny", max_seq_len=64)
+    eng = ContinuousEngine(spec, config=EngineConfig(
+        max_slots=4, max_seq_len=64, page_size=16, num_pages=64,
+        decode_steps_per_call=4, attention_impl="xla"))
+    rs = np.random.RandomState(3)
+    reqs = [GenerationRequest(
+        prompt=rs.randint(1, spec.vocab_size, size=5 + i).tolist(),
+        max_new_tokens=4, temperature=0.0, request_id=f"b{i}")
+        for i in range(4)]
+    out = eng.generate(reqs)
+    assert all(len(r.tokens) == 4 for r in out)
+    assert eng.get_metrics()["prefill_calls"] == 1
