@@ -76,19 +76,32 @@ def main() -> None:
     log(f"devices: {devs}")
 
     spec = gpt2_spec(MODEL)
+    # BENCH_ENGINE=continuous measures the serving engine (paged KV,
+    # batched admission) instead of the static batch engine. One device
+    # dispatch per chunk default for static (over a tunnelled/remote
+    # device the fixed per-launch latency dominates); the continuous
+    # engine interleaves admissions, so it keeps shorter chunks.
+    engine_kind = os.environ.get("BENCH_ENGINE", "static")
+    steps = int(os.environ.get(
+        "BENCH_STEPS", str(NEW_TOKENS if engine_kind == "static" else 64)))
     cfg = EngineConfig(
         max_slots=BATCH,
         max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
         prefill_buckets=[PROMPT_LEN],
-        # one device dispatch per chunk: over a tunnelled/remote device the
-        # fixed per-launch latency dominates, so default to one chunk per
-        # generation (the scan is on-device either way)
-        decode_steps_per_call=int(os.environ.get("BENCH_STEPS",
-                                                 str(NEW_TOKENS))),
+        decode_steps_per_call=steps,
     )
     t0 = time.perf_counter()
-    engine = Engine(spec, config=cfg)
-    log(f"engine init ({MODEL}): {time.perf_counter() - t0:.1f}s")
+    if engine_kind == "continuous":
+        from distributed_inference_engine_tpu.engine.continuous import (
+            ContinuousEngine,
+        )
+
+        cfg.page_size = 128
+        cfg.num_pages = max(64, BATCH * (PROMPT_LEN + NEW_TOKENS) // 128 + 8)
+        engine = ContinuousEngine(spec, config=cfg)
+    else:
+        engine = Engine(spec, config=cfg)
+    log(f"engine init ({MODEL}, {engine_kind}): {time.perf_counter() - t0:.1f}s")
 
     rs = np.random.RandomState(0)
 

@@ -13,8 +13,9 @@ Static-shape discipline (SURVEY.md §7 hard-part #1):
 
 - Decode always runs over ALL ``max_slots`` slots — inactive slots are
   masked, not removed, so one compiled chunk program serves every occupancy.
-- Prefill is bucketed per admission (batch=1, seq padded to a bucket), so at
-  most ``len(prefill_buckets)`` prefill programs exist.
+- Prefill is bucketed per admission round (batch padded to a power-of-two
+  bucket, seq to a prefill bucket): at most ``(log2(max_slots)+1) ×
+  len(prefill_buckets)`` prefill programs exist.
 - The decode chunk is ``lax.scan`` over ``decode_steps_per_call`` steps with
   pages donated in — zero per-token host round-trips, one small host sync
   per chunk.
@@ -345,9 +346,11 @@ class ContinuousEngine:
         state = _Slot(req, slot, prompt_len, on_tokens)
         state.tokens.append(first)
         state.produced = 1
-        state.first_token_at = time.perf_counter()
+        state.admitted_at = t0          # admission start (incl. prefill) —
+        state.first_token_at = time.perf_counter()   # so ttft_s is real
         self._slots[slot] = state
-        self.prefill_stats.add(state.first_token_at - t0)
+        # prefill_stats is recorded once per DISPATCH by the caller
+        # (batched admission would otherwise count one wall time N times)
         self._emit_stream(state)
 
         done = (req.eos_id >= 0 and first == req.eos_id) or \
@@ -397,6 +400,7 @@ class ContinuousEngine:
                       on_tokens=None) -> None:
         """Single-admission tail (suffix / disaggregated paths); batched
         admissions go through ``_admit_batch``."""
+        self.prefill_stats.add(time.perf_counter() - t0)
         if self._register_slot_host(req, slot, prompt_len, first, t0,
                                     on_tokens):
             self._install_device(
@@ -509,6 +513,7 @@ class ContinuousEngine:
         )
         self.kv.swap(kp, vp)
         firsts = np.asarray(first_dev)
+        self.prefill_stats.add(time.perf_counter() - t0)   # once per dispatch
         rows: List[Dict[str, Any]] = []
         for i, (req, cb, slot, prompt) in enumerate(batch):
             if self.prefix_cache:
