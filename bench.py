@@ -97,7 +97,8 @@ def main() -> None:
         )
 
         cfg.page_size = 128
-        cfg.num_pages = max(64, BATCH * (PROMPT_LEN + NEW_TOKENS) // 128 + 8)
+        per_seq = -(-(PROMPT_LEN + NEW_TOKENS) // cfg.page_size)  # ceil
+        cfg.num_pages = max(64, BATCH * per_seq + 8)
         engine = ContinuousEngine(spec, config=cfg)
     else:
         engine = Engine(spec, config=cfg)

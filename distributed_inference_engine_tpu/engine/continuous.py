@@ -271,7 +271,7 @@ class ContinuousEngine:
         self._total_requests += 1
         if not request.request_id:
             request.request_id = f"creq-{self._total_requests}"
-        self._waiting.append((request, on_tokens))
+        self._waiting.append((request, on_tokens, time.perf_counter()))
         return request.request_id
 
     def submit_prefilled(self, request: GenerationRequest, handoff: Any,
@@ -299,7 +299,8 @@ class ContinuousEngine:
         self._total_requests += 1
         if not request.request_id:
             request.request_id = f"creq-{self._total_requests}"
-        self._waiting_prefilled.append((request, handoff, on_tokens))
+        self._waiting_prefilled.append((request, handoff, on_tokens,
+                                        time.perf_counter()))
         return request.request_id
 
     # ---------------------------------------------------------- admission
@@ -309,7 +310,7 @@ class ContinuousEngine:
         prefill program — the disaggregated half of ``_try_admit``."""
         admitted = 0
         while self._waiting_prefilled:
-            req, handoff, on_tok = self._waiting_prefilled[0]
+            req, handoff, on_tok, t_submit = self._waiting_prefilled[0]
             prompt_len = handoff.prompt_len
             slot = self.kv.alloc_slot(prompt_len)
             if slot is None:
@@ -335,19 +336,21 @@ class ContinuousEngine:
             self.kv.swap(kp, vp)
             self._total_prompt_tokens += prompt_len
             self._install_slot(req, slot, prompt_len, handoff.first_token,
-                               t0, on_tok)
+                               t0, on_tok, t_submit=t_submit)
         return admitted
 
     def _register_slot_host(self, req: GenerationRequest, slot: int,
-                            prompt_len: int, first: int, t0: float,
+                            prompt_len: int, first: int, t_submit: float,
                             on_tokens=None) -> bool:
         """Host bookkeeping of one admission; returns True when the slot
         stays live (i.e. needs its device state installed)."""
         state = _Slot(req, slot, prompt_len, on_tokens)
         state.tokens.append(first)
         state.produced = 1
-        state.admitted_at = t0          # admission start (incl. prefill) —
-        state.first_token_at = time.perf_counter()   # so ttft_s is real
+        # the TTFT clock starts at SUBMIT: queue wait while slots/pages
+        # were busy is exactly the latency a loaded engine must report
+        state.admitted_at = t_submit
+        state.first_token_at = time.perf_counter()
         self._slots[slot] = state
         # prefill_stats is recorded once per DISPATCH by the caller
         # (batched admission would otherwise count one wall time N times)
@@ -396,13 +399,15 @@ class ContinuousEngine:
                 "top_p": req.top_p}
 
     def _install_slot(self, req: GenerationRequest, slot: int,
-                      prompt_len: int, first: int, t0: float,
-                      on_tokens=None) -> None:
+                      prompt_len: int, first: int, t_dispatch: float,
+                      on_tokens=None, t_submit: float = 0.0) -> None:
         """Single-admission tail (suffix / disaggregated paths); batched
-        admissions go through ``_admit_batch``."""
-        self.prefill_stats.add(time.perf_counter() - t0)
-        if self._register_slot_host(req, slot, prompt_len, first, t0,
-                                    on_tokens):
+        admissions go through ``_admit_batch``. ``t_dispatch`` feeds the
+        prefill-latency histogram; ``t_submit`` (falls back to
+        ``t_dispatch``) starts the request's TTFT clock."""
+        self.prefill_stats.add(time.perf_counter() - t_dispatch)
+        if self._register_slot_host(req, slot, prompt_len, first,
+                                    t_submit or t_dispatch, on_tokens):
             self._install_device(
                 [self._slot_row(req, slot, prompt_len, first)])
 
@@ -422,7 +427,7 @@ class ContinuousEngine:
         # its alloc sees the registered pages and takes the suffix path)
         pending_hashes: set = set()
         while self._waiting:
-            req, on_tok = self._waiting[0]
+            req, on_tok, t_submit = self._waiting[0]
             # overlong prompts keep their tail (sliding-window truncation,
             # same policy as Engine.generate); cap leaves ≥1 decode position
             prompt = req.prompt[-(self.max_seq_len - 1):]
@@ -462,9 +467,10 @@ class ContinuousEngine:
                 self.kv.register_prefix(slot, prompt)
                 first = int(np.asarray(first_dev)[0])
                 self._total_prompt_tokens += len(prompt)
-                self._install_slot(req, slot, len(prompt), first, t0, on_tok)
+                self._install_slot(req, slot, len(prompt), first, t0,
+                                   on_tok, t_submit=t_submit)
             else:
-                batch.append((req, on_tok, slot, prompt))
+                batch.append((req, on_tok, slot, prompt, t_submit))
                 if len(batch) >= self.max_slots:
                     self._admit_batch(batch)
                     batch = []
@@ -485,7 +491,7 @@ class ContinuousEngine:
         self._prefill_calls += 1
         n = len(batch)
         bb = 1 << (n - 1).bit_length()                     # pow2 bucket
-        tb = _next_bucket(max(len(p) for _, _, _, p in batch),
+        tb = _next_bucket(max(len(p) for _, _, _, p, _ in batch),
                           self.prefill_buckets)
         tokens = np.zeros((bb, tb), np.int32)
         seq_lens = np.zeros((bb,), np.int32)
@@ -493,7 +499,7 @@ class ContinuousEngine:
         top_k = np.zeros((bb,), np.int32)
         top_p = np.ones((bb,), np.float32)
         table_rows = np.zeros((bb, self.kv.max_pages_per_seq), np.int32)
-        for i, (req, _cb, slot, prompt) in enumerate(batch):
+        for i, (req, _cb, slot, prompt, _ts) in enumerate(batch):
             tokens[i, : len(prompt)] = prompt
             seq_lens[i] = len(prompt)
             temps[i] = req.temperature
@@ -515,13 +521,13 @@ class ContinuousEngine:
         firsts = np.asarray(first_dev)
         self.prefill_stats.add(time.perf_counter() - t0)   # once per dispatch
         rows: List[Dict[str, Any]] = []
-        for i, (req, cb, slot, prompt) in enumerate(batch):
+        for i, (req, cb, slot, prompt, t_submit) in enumerate(batch):
             if self.prefix_cache:
                 self.kv.register_prefix(slot, prompt)
             self._total_prompt_tokens += len(prompt)
             first = int(firsts[i])
             if self._register_slot_host(req, slot, len(prompt), first,
-                                        t0, cb):
+                                        t_submit, cb):
                 rows.append(self._slot_row(req, slot, len(prompt), first))
         self._install_device(rows)
 
