@@ -124,13 +124,15 @@ class ContinuousEngine:
         self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
         self._prefix_hit_admissions = 0
 
-        # ---- queues / state: (request, stream cb or None)
-        self._waiting: Deque[Tuple[GenerationRequest, Any]] = (
+        # ---- queues / state: (request, stream cb or None, t_submit)
+        self._waiting: Deque[Tuple[GenerationRequest, Any, float]] = (
             collections.deque()
         )
         # disaggregated admissions whose prefill already ran on a
-        # prefill-pool worker (engine/disagg.py): (request, handoff, cb)
-        self._waiting_prefilled: Deque[Tuple[GenerationRequest, Any, Any]] = (
+        # prefill-pool worker (engine/disagg.py):
+        # (request, handoff, cb, t_submit)
+        self._waiting_prefilled: Deque[
+            Tuple[GenerationRequest, Any, Any, float]] = (
             collections.deque()
         )
         self._slots: Dict[int, _Slot] = {}
@@ -256,6 +258,8 @@ class ContinuousEngine:
         self._capacity_finishes = 0
         self._steps = 0
         self._prefill_calls = 0     # batched-admission dispatches
+        self._occupancy_sum = 0     # Σ live slots per step (occupancy)
+        self.ttft_stats = LatencyStats()   # per-request, from submit
 
     # ------------------------------------------------------------- submit
 
@@ -351,6 +355,7 @@ class ContinuousEngine:
         # were busy is exactly the latency a loaded engine must report
         state.admitted_at = t_submit
         state.first_token_at = time.perf_counter()
+        self.ttft_stats.add(state.first_token_at - t_submit)
         self._slots[slot] = state
         # prefill_stats is recorded once per DISPATCH by the caller
         # (batched admission would otherwise count one wall time N times)
@@ -400,14 +405,14 @@ class ContinuousEngine:
 
     def _install_slot(self, req: GenerationRequest, slot: int,
                       prompt_len: int, first: int, t_dispatch: float,
-                      on_tokens=None, t_submit: float = 0.0) -> None:
+                      on_tokens, t_submit: float) -> None:
         """Single-admission tail (suffix / disaggregated paths); batched
         admissions go through ``_admit_batch``. ``t_dispatch`` feeds the
-        prefill-latency histogram; ``t_submit`` (falls back to
-        ``t_dispatch``) starts the request's TTFT clock."""
+        prefill-latency histogram; ``t_submit`` starts the request's
+        TTFT clock (queue wait included)."""
         self.prefill_stats.add(time.perf_counter() - t_dispatch)
         if self._register_slot_host(req, slot, prompt_len, first,
-                                    t_submit or t_dispatch, on_tokens):
+                                    t_submit, on_tokens):
             self._install_device(
                 [self._slot_row(req, slot, prompt_len, first)])
 
@@ -614,6 +619,7 @@ class ContinuousEngine:
         if not self._slots:
             return 0
         self._steps += 1
+        self._occupancy_sum += len(self._slots)   # batch occupancy metric
 
         # capacity: grow every active slot toward a full chunk; a slot that
         # can't even fit one more token is finished (pool pressure or cap)
@@ -725,6 +731,13 @@ class ContinuousEngine:
             "engine_steps": self._steps,
             "prefill_calls": self._prefill_calls,
             "prefix_hit_admissions": self._prefix_hit_admissions,
+            # serving metrics the reference's mock could never know
+            # (SURVEY.md §5): per-request TTFT from submit, and mean decode
+            # batch occupancy (live slots / max_slots per engine step)
+            "ttft": self.ttft_stats.snapshot(),
+            "batch_occupancy": (self._occupancy_sum
+                                / (self._steps * self.max_slots)
+                                if self._steps else 0.0),
             "prefill": self.prefill_stats.snapshot(),
             "decode_chunk": self.chunk_stats.snapshot(),
             "kv": self.kv.get_stats(),

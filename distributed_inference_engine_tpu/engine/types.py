@@ -30,6 +30,9 @@ class GenerationResult:
     tokens: List[int]                 # generated token ids (no prompt)
     finish_reason: str                # "stop" | "length"
     prompt_tokens: int = 0
-    ttft_s: float = 0.0               # prefill + first sample wall time
+    # time to first token. Static/speculative engines measure from the
+    # generate dispatch (prefill + first sample); the continuous engine
+    # measures from SUBMIT, so queue wait under load is included.
+    ttft_s: float = 0.0
     decode_s: float = 0.0
     metadata: Dict[str, Any] = field(default_factory=dict)

@@ -152,3 +152,32 @@ def test_batched_admission_single_prefill_dispatch():
     out = eng.generate(reqs)
     assert all(len(r.tokens) == 4 for r in out)
     assert eng.get_metrics()["prefill_calls"] == 1
+
+
+def test_serving_metrics_ttft_and_occupancy():
+    """SURVEY §5 serving metrics: per-request TTFT (measured from submit,
+    so queue wait counts) and mean decode batch occupancy."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+    from distributed_inference_engine_tpu.engine.types import GenerationRequest
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+
+    spec = llama_spec("llama-tiny", max_seq_len=64)
+    eng = ContinuousEngine(spec, config=EngineConfig(
+        max_slots=2, max_seq_len=64, page_size=16, num_pages=32,
+        decode_steps_per_call=4, attention_impl="xla"))
+    # 4 requests on 2 slots: the second wave queues behind the first
+    out = eng.generate([GenerationRequest(
+        prompt=[1 + i, 2, 3], max_new_tokens=8, temperature=0.0,
+        request_id=f"q{i}") for i in range(4)])
+    m = eng.get_metrics()
+    assert m["ttft"]["count"] == 4
+    assert 0.0 < m["batch_occupancy"] <= 1.0
+    # queued requests' ttft includes their wait: their result ttft must be
+    # at least the first wave's decode time (strictly > admission-only)
+    ttfts = sorted(r.ttft_s for r in out)
+    assert ttfts[-1] > ttfts[0]
