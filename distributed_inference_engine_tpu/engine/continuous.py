@@ -286,6 +286,11 @@ class ContinuousEngine:
         (``[L, T, Hkv, Dh]`` numpy, already in the cache dtype) plus the
         first sampled token. Admission scatters the KV into paged slots and
         decoding proceeds exactly as for a locally-prefilled sequence.
+
+        TTFT caveat: the clock starts HERE — the prefill-pool hop happened
+        in another process whose monotonic clock is not comparable, so
+        disaggregated ``ttft_s`` covers this decode worker only; the
+        coordinator's ``RequestTrace`` carries the end-to-end latency.
         """
         L, T, Hkv, Dh = handoff.k.shape
         if (L, Hkv, Dh) != (self.spec.n_layers, self.spec.n_kv_heads,

@@ -72,3 +72,28 @@ def test_default_config_is_valid():
     cfg = Config()
     d = cfg.to_dict()
     assert "engine" in d and "mesh" in d
+
+
+def test_multihost_config_section(tmp_path):
+    """The multihost section round-trips through the config-file loader
+    (pod-slice deployments drive workers from files, not flags)."""
+    import json
+
+    from distributed_inference_engine_tpu.config import load_config
+
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps({
+        "server": {"worker_id": "h0", "port": 9000},
+        "multihost": {"enabled": True,
+                      "coordinator_address": "10.0.0.1:8476",
+                      "num_processes": 4, "process_id": 2},
+    }))
+    cfg = load_config(str(p))
+    assert cfg.multihost.enabled is True
+    assert cfg.multihost.coordinator_address == "10.0.0.1:8476"
+    assert cfg.multihost.num_processes == 4
+    assert cfg.multihost.process_id == 2
+    # defaults when absent
+    p2 = tmp_path / "w2.json"
+    p2.write_text(json.dumps({"server": {"worker_id": "h1"}}))
+    assert load_config(str(p2)).multihost.enabled is False

@@ -157,8 +157,6 @@ def test_batched_admission_single_prefill_dispatch():
 def test_serving_metrics_ttft_and_occupancy():
     """SURVEY §5 serving metrics: per-request TTFT (measured from submit,
     so queue wait counts) and mean decode batch occupancy."""
-    import numpy as np
-
     from distributed_inference_engine_tpu.config import EngineConfig
     from distributed_inference_engine_tpu.engine.continuous import (
         ContinuousEngine,
