@@ -10,7 +10,20 @@ from .base import (  # noqa: F401
 )
 from .gpt2 import gpt2_spec  # noqa: F401
 from .llama import llama_spec, mixtral_spec  # noqa: F401
+from .qwen import qwen_spec  # noqa: F401
+from .mistral import mistral_spec  # noqa: F401
+from .gemma import gemma_spec  # noqa: F401
 from .fake import FakeEngine  # noqa: F401
+
+# family prefix -> (spec factory, default size). Sizes live in each family
+# module; architecture strings like "qwen2-7b" select the size directly.
+_FAMILIES = {
+    "qwen": (qwen_spec, "qwen2-7b"),
+    "mistral": (mistral_spec, "mistral-7b"),
+    "gemma": (gemma_spec, "gemma-7b"),
+    "mixtral": (mixtral_spec, "mixtral-8x7b"),
+    "llama": (llama_spec, "llama3-8b"),
+}
 
 
 def build_engine(architecture: str, **kwargs):
@@ -24,15 +37,7 @@ def build_engine(architecture: str, **kwargs):
         return FakeEngine(**{k: v for k, v in kwargs.items() if k in fake_keys})
     from ..engine.engine import Engine
 
-    if architecture.startswith("gpt2"):
-        spec = gpt2_spec(architecture if architecture in (
-            "gpt2", "gpt2-medium", "gpt2-large", "gpt2-xl") else "gpt2")
-    elif architecture.startswith("llama"):
-        spec = llama_spec(architecture if "-" in architecture else "llama3-8b")
-    elif architecture.startswith("mixtral"):
-        spec = mixtral_spec(architecture if "-" in architecture else "mixtral-8x7b")
-    else:
-        raise ValueError(f"unknown architecture {architecture!r}")
+    spec = spec_for_architecture(architecture)
     real_keys = ("params", "config", "seed", "shard_fn")
     return Engine(spec, **{k: v for k, v in kwargs.items() if k in real_keys})
 
@@ -43,13 +48,13 @@ def spec_for_architecture(architecture: str, size: str = "",
     config-driven factory below) so matching can't drift."""
     overrides = {"max_seq_len": max_seq_len} if max_seq_len else {}
     if architecture.startswith("gpt2"):
+        # unknown sizes raise in gpt2_spec — a typo'd deploy must fail
+        # loudly, not silently serve the 124M default
         return gpt2_spec(size or architecture, **overrides)
-    if architecture.startswith("llama"):
-        name = size or (architecture if "-" in architecture else "llama3-8b")
-        return llama_spec(name, **overrides)
-    if architecture.startswith("mixtral"):
-        name = size or (architecture if "-" in architecture else "mixtral-8x7b")
-        return mixtral_spec(name, **overrides)
+    for prefix, (factory, default) in _FAMILIES.items():
+        if architecture.startswith(prefix):
+            name = size or (architecture if "-" in architecture else default)
+            return factory(name, **overrides)
     raise ValueError(f"unknown architecture {architecture!r}")
 
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -61,7 +62,7 @@ class ModelSpec:
     max_seq_len: int = 2048
     pos_emb: str = "rope"          # "rope" | "learned"
     norm: str = "rmsnorm"          # "rmsnorm" | "layernorm"
-    mlp: str = "swiglu"            # "swiglu" | "gelu"
+    mlp: str = "swiglu"            # "swiglu" | "gelu" | "geglu"
     use_bias: bool = False
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
@@ -72,22 +73,33 @@ class ModelSpec:
     n_experts: int = 0
     experts_per_token: int = 2
     capacity_factor: float = 1.25
+    # Family variations beyond the GPT-2/Llama axes:
+    qkv_bias: bool = False         # Qwen2: bias on q/k/v projections only
+    head_dim_override: int = 0     # Gemma: head_dim decoupled from d_model/n_heads
+    emb_scale: bool = False        # Gemma: embeddings scaled by sqrt(d_model)
+    norm_plus_one: bool = False    # Gemma: RMSNorm applies (1 + weight)
+    logit_softcap: float = 0.0     # Gemma-2: cap * tanh(logits / cap)
+    sliding_window: int = 0        # Mistral v0.1: window size; 0 = full attention
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def jnp_dtype(self):
         return jnp.dtype(self.dtype)
 
     def validate(self) -> "ModelSpec":
-        if self.d_model % self.n_heads:
+        if not self.head_dim_override and self.d_model % self.n_heads:
             raise ValueError("d_model must divide by n_heads")
         if self.n_heads % self.n_kv_heads:
             raise ValueError("n_heads must divide by n_kv_heads")
         if self.pos_emb not in ("rope", "learned"):
             raise ValueError(f"unknown pos_emb {self.pos_emb}")
+        if self.mlp not in ("swiglu", "gelu", "geglu"):
+            raise ValueError(f"unknown mlp {self.mlp}")
+        if self.sliding_window < 0:
+            raise ValueError("sliding_window must be >= 0")
         if self.n_experts:
             if not 1 <= self.experts_per_token <= self.n_experts:
                 raise ValueError(
@@ -141,7 +153,7 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
         from ..ops.moe import init_moe_blocks
 
         blocks.update(init_moe_blocks(spec, keys, norm_))
-    elif spec.mlp == "swiglu":
+    elif spec.mlp in ("swiglu", "geglu"):
         blocks["w_gate"] = norm_((L, D, F), next(keys))
         blocks["w_up"] = norm_((L, D, F), next(keys))
         blocks["w_down"] = norm_((L, F, D), next(keys), out_std)
@@ -151,10 +163,11 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
     if spec.norm == "layernorm":
         blocks["ln1_bias"] = jnp.zeros((L, D), dtype=dt)
         blocks["ln2_bias"] = jnp.zeros((L, D), dtype=dt)
-    if spec.use_bias:
+    if spec.use_bias or spec.qkv_bias:
         blocks["bq"] = jnp.zeros((L, H * Dh), dtype=dt)
         blocks["bk"] = jnp.zeros((L, Hkv * Dh), dtype=dt)
         blocks["bv"] = jnp.zeros((L, Hkv * Dh), dtype=dt)
+    if spec.use_bias:
         blocks["bo"] = jnp.zeros((L, D), dtype=dt)
         blocks["b_up"] = jnp.zeros((L, F), dtype=dt)
         blocks["b_down"] = jnp.zeros((L, D), dtype=dt)
@@ -179,6 +192,10 @@ def init_params(spec: ModelSpec, key: jax.Array) -> Params:
 def _norm(spec: ModelSpec, x, scale, bias):
     if spec.norm == "layernorm":
         return layer_norm(x, scale, bias, spec.norm_eps)
+    if spec.norm_plus_one:
+        # Gemma stores RMSNorm weights as (w - 1); add the 1 back in fp32
+        # so small stored weights keep their precision
+        scale = scale.astype(jnp.float32) + 1.0
     return rms_norm(x, scale, spec.norm_eps)
 
 
@@ -192,10 +209,12 @@ def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
         from ..ops.moe import moe_mlp
 
         return moe_mlp(spec, blk, x, exact=exact_moe)
-    if spec.mlp == "swiglu":
+    if spec.mlp in ("swiglu", "geglu"):
         gate = matmul_any("btd,df->btf", x, blk["w_gate"])
         up = matmul_any("btd,df->btf", x, blk["w_up"])
-        h = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        act = (jax.nn.silu if spec.mlp == "swiglu"
+               else partial(jax.nn.gelu, approximate=True))   # geglu: Gemma
+        h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
     else:
         h = matmul_any("btd,df->btf", x, blk["w_up"])
         if spec.use_bias:
@@ -213,7 +232,7 @@ def _qkv(spec: ModelSpec, blk: Params, x, positions):
     q = matmul_any("btd,de->bte", x, blk["wq"])
     k = matmul_any("btd,de->bte", x, blk["wk"])
     v = matmul_any("btd,de->bte", x, blk["wv"])
-    if spec.use_bias:
+    if spec.use_bias or spec.qkv_bias:
         q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
     q = q.reshape(b, t, H, Dh)
     k = k.reshape(b, t, Hkv, Dh)
@@ -236,6 +255,10 @@ def embed(spec: ModelSpec, params: Params, tokens: jnp.ndarray,
           positions: jnp.ndarray) -> jnp.ndarray:
     """[B, T] tokens -> [B, T, D] activations."""
     x = params["tok_emb"][tokens]
+    if spec.emb_scale:
+        # Gemma: normalizer cast to the activation dtype before the multiply
+        # (matches the family's published numerics)
+        x = x * jnp.asarray(spec.d_model ** 0.5, dtype=x.dtype)
     if spec.pos_emb == "learned":
         x = x + params["pos_emb"][positions]
     return x
@@ -246,12 +269,17 @@ def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray
     h = _norm(spec, hidden, params["lnf_scale"], params.get("lnf_bias"))
     w = params["tok_emb"].T if spec.tie_embeddings else params["lm_head"]
     if isinstance(w, QuantizedTensor):
-        return matmul_any("...d,dv->...v", h.astype(jnp.float32), w)
-    # keep the [D, V] projection in its storage dtype (bf16: half the HBM
-    # read of an fp32 upcast — this matmul streams the largest single
-    # weight every decode step) and accumulate in fp32 on the MXU
-    return jnp.einsum("...d,dv->...v", h.astype(w.dtype), w,
-                      preferred_element_type=jnp.float32)
+        logits = matmul_any("...d,dv->...v", h.astype(jnp.float32), w)
+    else:
+        # keep the [D, V] projection in its storage dtype (bf16: half the HBM
+        # read of an fp32 upcast — this matmul streams the largest single
+        # weight every decode step) and accumulate in fp32 on the MXU
+        logits = jnp.einsum("...d,dv->...v", h.astype(w.dtype), w,
+                            preferred_element_type=jnp.float32)
+    if spec.logit_softcap:
+        cap = spec.logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 # ------------------------------------------------------------------ prefill
@@ -287,7 +315,8 @@ def _prefill_scan(
     def body(x, blk):
         h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
         q, k, v = _qkv(spec, blk, h, positions)
-        attn = causal_attention(q, k, v, seq_lens)
+        attn = causal_attention(q, k, v, seq_lens,
+                                window=spec.sliding_window)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
         m, aux = _mlp(spec, blk, h2, exact_moe=exact_moe)
@@ -322,7 +351,8 @@ def forward_prefill_suffix(
         blk, ck, cv = per_layer
         h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
         q, k, v = _qkv(spec, blk, h, positions)
-        attn = suffix_attention(q, ck, cv, n_ctx, k, v, suffix_lens)
+        attn = suffix_attention(q, ck, cv, n_ctx, k, v, suffix_lens,
+                                window=spec.sliding_window)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
         m, _ = _mlp(spec, blk, h2)
@@ -372,7 +402,8 @@ def forward_window(
         ck = ck.at[batch_idx, pos_w].set(k.astype(ck.dtype), mode="drop")
         cv = cv.at[batch_idx, pos_w].set(v.astype(cv.dtype), mode="drop")
         attn = suffix_attention(
-            q, ck.astype(q.dtype), cv.astype(q.dtype), start, k, v, n_valid
+            q, ck.astype(q.dtype), cv.astype(q.dtype), start, k, v, n_valid,
+            window=spec.sliding_window,
         )
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
@@ -412,7 +443,8 @@ def forward_decode(
         q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
         ck = ck.at[batch_idx, lengths].set(k[:, 0])
         cv = cv.at[batch_idx, lengths].set(v[:, 0])
-        attn = cached_attention(q, ck, cv, lengths + 1)
+        attn = cached_attention(q, ck, cv, lengths + 1,
+                                window=spec.sliding_window)
         x = x + _out_proj(spec, blk, attn)
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
         m, _ = _mlp(spec, blk, h2)
@@ -477,6 +509,7 @@ def forward_decode_paged(
         attn = paged_attention(
             q[:, 0], kp, vp, page_table, lengths + 1,
             n_kv_heads=spec.n_kv_heads, impl=attn_impl,
+            window=spec.sliding_window,
         )
         x = x + _out_proj(spec, blk, attn[:, None])
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))

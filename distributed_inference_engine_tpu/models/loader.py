@@ -98,6 +98,10 @@ def _map_llama_attn(raw: Dict[str, np.ndarray], spec: ModelSpec,
         "wv": _stack(raw, pre + "layers.{}.self_attn.v_proj.weight", L, transpose=True),
         "wo": _stack(raw, pre + "layers.{}.self_attn.o_proj.weight", L, transpose=True),
     }
+    if spec.qkv_bias:   # Qwen2: biases on q/k/v only
+        blocks["bq"] = _stack(raw, pre + "layers.{}.self_attn.q_proj.bias", L)
+        blocks["bk"] = _stack(raw, pre + "layers.{}.self_attn.k_proj.bias", L)
+        blocks["bv"] = _stack(raw, pre + "layers.{}.self_attn.v_proj.bias", L)
     emb_key = (pre + "embed_tokens.weight") if pre else "embed_tokens.weight"
     params = {
         "tok_emb": raw[emb_key],
@@ -183,11 +187,46 @@ def load_checkpoint(path: str, spec: ModelSpec) -> Params:
     return jax.tree.map(cast, tree)
 
 
+def _llama_like(cfg: Dict[str, Any], **quirks: Any) -> ModelSpec:
+    """Common spec kwargs for every Llama-shaped HF config (llama, mixtral,
+    qwen2, mistral, gemma); the family branches pass only their
+    distinguishing flags so a shared fix lands in one place."""
+    base: Dict[str, Any] = dict(
+        vocab_size=cfg["vocab_size"],
+        d_model=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+        d_ff=cfg["intermediate_size"],
+        max_seq_len=cfg.get("max_position_embeddings", 4096),
+        pos_emb="rope",
+        norm="rmsnorm",
+        mlp="swiglu",
+        use_bias=False,
+        tie_embeddings=cfg.get("tie_word_embeddings", False),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        norm_eps=cfg.get("rms_norm_eps", 1e-5),
+    )
+    base.update(quirks)
+    return ModelSpec(**base).validate()
+
+
 def spec_from_hf_config(path: str) -> ModelSpec:
-    """Build a ModelSpec from a HF ``config.json``."""
+    """Build a ModelSpec from a HF ``config.json``.
+
+    Matches on ``model_type`` (authoritative in HF configs) with the
+    architectures[] string as fallback. Unsupported relatives that share a
+    name prefix (gemma2/gemma3, qwen3, ...) must NOT fall through to a
+    near-miss spec — loading e.g. a Gemma-2 checkpoint as Gemma-1 would run
+    without error and generate garbage — so matching is exact."""
     cfg = json.loads((pathlib.Path(path) / "config.json").read_text())
     arch = (cfg.get("architectures") or [""])[0].lower()
-    if "gpt2" in arch or cfg.get("model_type") == "gpt2":
+    mt = cfg.get("model_type", "")
+
+    def is_(family: str) -> bool:
+        return mt == family or arch == f"{family}forcausallm"
+
+    if mt == "gpt2" or "gpt2" in arch:
         return ModelSpec(
             vocab_size=cfg["vocab_size"],
             d_model=cfg["n_embd"],
@@ -203,43 +242,43 @@ def spec_from_hf_config(path: str) -> ModelSpec:
             tie_embeddings=True,
             norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
         ).validate()
-    if "mixtral" in arch or cfg.get("model_type") == "mixtral":
-        return ModelSpec(
-            vocab_size=cfg["vocab_size"],
-            d_model=cfg["hidden_size"],
-            n_layers=cfg["num_hidden_layers"],
-            n_heads=cfg["num_attention_heads"],
-            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
-            d_ff=cfg["intermediate_size"],
+    if is_("mixtral"):
+        return _llama_like(
+            cfg,
             max_seq_len=cfg.get("max_position_embeddings", 32768),
-            pos_emb="rope",
-            norm="rmsnorm",
-            mlp="swiglu",
-            use_bias=False,
-            tie_embeddings=cfg.get("tie_word_embeddings", False),
             rope_theta=cfg.get("rope_theta", 1e6),
-            norm_eps=cfg.get("rms_norm_eps", 1e-5),
             n_experts=cfg["num_local_experts"],
             experts_per_token=cfg.get("num_experts_per_tok", 2),
-        ).validate()
-    if "llama" in arch or cfg.get("model_type") == "llama":
-        return ModelSpec(
-            vocab_size=cfg["vocab_size"],
-            d_model=cfg["hidden_size"],
-            n_layers=cfg["num_hidden_layers"],
-            n_heads=cfg["num_attention_heads"],
-            n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
-            d_ff=cfg["intermediate_size"],
-            max_seq_len=cfg.get("max_position_embeddings", 4096),
-            pos_emb="rope",
-            norm="rmsnorm",
-            mlp="swiglu",
-            use_bias=False,
-            tie_embeddings=cfg.get("tie_word_embeddings", False),
-            rope_theta=cfg.get("rope_theta", 10000.0),
-            norm_eps=cfg.get("rms_norm_eps", 1e-5),
-        ).validate()
-    raise ValueError(f"unsupported architecture in {path}: {arch}")
+        )
+    if is_("qwen2"):
+        return _llama_like(
+            cfg,
+            max_seq_len=cfg.get("max_position_embeddings", 32768),
+            rope_theta=cfg.get("rope_theta", 1e6),
+            norm_eps=cfg.get("rms_norm_eps", 1e-6),
+            qkv_bias=True,
+        )
+    if is_("mistral"):
+        return _llama_like(
+            cfg,
+            max_seq_len=cfg.get("max_position_embeddings", 32768),
+            sliding_window=cfg.get("sliding_window") or 0,
+        )
+    if is_("gemma"):
+        return _llama_like(
+            cfg,
+            max_seq_len=cfg.get("max_position_embeddings", 8192),
+            mlp="geglu",
+            tie_embeddings=True,   # Gemma always ties; HF omits lm_head
+            norm_eps=cfg.get("rms_norm_eps", 1e-6),
+            head_dim_override=cfg.get("head_dim", 0),
+            emb_scale=True,
+            norm_plus_one=True,
+        )
+    if is_("llama"):
+        return _llama_like(cfg)
+    raise ValueError(f"unsupported architecture in {path}: "
+                     f"model_type={mt!r} architectures={arch!r}")
 
 
 def save_checkpoint_gpt2(path: str, params: Params, spec: ModelSpec) -> None:

@@ -37,6 +37,7 @@ def causal_attention(
     k: jnp.ndarray,          # [B, T, Hkv, Dh]
     v: jnp.ndarray,          # [B, T, Hkv, Dh]
     seq_lens: jnp.ndarray,   # [B] valid prompt lengths (right-padded batches)
+    window: int = 0,         # sliding-window size (0 = full causal)
 ) -> jnp.ndarray:
     """Prefill attention: causal within the prompt, padding masked out.
 
@@ -51,6 +52,8 @@ def causal_attention(
     i = jnp.arange(t)[:, None]
     j = jnp.arange(t)[None, :]
     causal = j <= i                                              # [T, T]
+    if window:
+        causal &= (i - j) < window                               # Mistral SWA
     valid = jnp.arange(t)[None, :] < seq_lens[:, None]           # [B, T] keys in-prompt
     mask = causal[None, :, :] & valid[:, None, :]                # [B, T, T]
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
@@ -68,6 +71,7 @@ def suffix_attention(
     k_suf: jnp.ndarray,        # [B, Ts, Hkv, Dh] fresh suffix keys
     v_suf: jnp.ndarray,        # [B, Ts, Hkv, Dh]
     suffix_lens: jnp.ndarray,  # [B] valid suffix length per row
+    window: int = 0,           # sliding-window size (0 = full causal)
 ) -> jnp.ndarray:
     """Prefill of a prompt SUFFIX against cached prefix KV (prefix cache
     hit, ``engine/paged_kv.py``): suffix query i (absolute position
@@ -91,6 +95,12 @@ def suffix_attention(
     mask_suf = (~in_ctx) & causal[None, :, :] & \
         (suf_j[None, :, :] < suffix_lens[:, None, None])
     mask = mask_ctx | mask_suf                                   # [B, Ts, Tc+Ts]
+    if window:
+        # absolute positions: query = n_ctx + i; ctx key = j; suffix key =
+        # n_ctx + suf_j — the query sees only the last `window` positions
+        q_abs = n_ctx[:, None, None] + i[None, :, :]             # [B, Ts, 1]
+        k_abs = jnp.where(in_ctx, j, n_ctx[:, None, None] + suf_j)
+        mask &= (q_abs - k_abs) < window
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
@@ -103,6 +113,7 @@ def cached_attention(
     cache_k: jnp.ndarray,    # [B, S, Hkv, Dh] full HBM cache rows
     cache_v: jnp.ndarray,    # [B, S, Hkv, Dh]
     lengths: jnp.ndarray,    # [B] live length per slot (incl. the new token)
+    window: int = 0,         # sliding-window size (0 = full attention)
 ) -> jnp.ndarray:
     """Decode attention against the KV cache, masked to each slot's live
     prefix. Returns [B, 1, H, Dh]."""
@@ -113,6 +124,9 @@ def cached_attention(
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     scores = jnp.einsum("bikgd,bjkd->bkgij", qg, cache_k).astype(jnp.float32) * scale
     valid = jnp.arange(s)[None, :] < lengths[:, None]            # [B, S]
+    if window:
+        # query sits at position lengths-1; only keys within the window
+        valid &= jnp.arange(s)[None, :] >= (lengths[:, None] - window)
     scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

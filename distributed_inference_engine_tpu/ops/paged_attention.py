@@ -63,6 +63,7 @@ def paged_attention_xla(
     lengths: jnp.ndarray,      # [B] int32
     *,
     n_kv_heads: int,
+    window: int = 0,           # sliding-window size (0 = full attention)
 ) -> jnp.ndarray:
     """Reference implementation via gather; correct everywhere (CPU tests,
     interpret-mode cross-check), but reads the whole gathered cache through
@@ -81,6 +82,8 @@ def paged_attention_xla(
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k).astype(jnp.float32) * scale
     valid = jnp.arange(mp * p)[None, :] < lengths[:, None]        # [B, S]
+    if window:
+        valid &= jnp.arange(mp * p)[None, :] >= (lengths[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
@@ -111,6 +114,7 @@ def _paged_attn_kernel(
     head_dim: int,
     page_size: int,
     n_heads: int,
+    window: int,
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -126,8 +130,11 @@ def _paged_attn_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # pages past the live prefix contribute nothing; skip their FLOPs
+    # pages past the live prefix contribute nothing; skip their FLOPs —
+    # and with a sliding window, so do pages wholly before the window
     live = p * page_size < length
+    if window:
+        live &= (p + 1) * page_size > length - window
 
     # constant 0/1 map, folded into the compiled kernel:
     # S [H*Dh, H] segment-sums each head's Dh lanes; S.T broadcasts back
@@ -158,7 +165,10 @@ def _paged_attn_kernel(
         scores = scores * (1.0 / (dh ** 0.5))
         tok = p * page_size + lax.broadcasted_iota(
             jnp.int32, (page_size, H), 0)
-        scores = jnp.where(tok < length, scores, NEG_INF)
+        in_range = tok < length
+        if window:
+            in_range &= tok >= length - window
+        scores = jnp.where(in_range, scores, NEG_INF)
 
         m_prev = m_scr[:]                                      # [1, H]
         l_prev = l_scr[:]
@@ -195,6 +205,7 @@ def paged_attention_pallas(
     lengths: jnp.ndarray,      # [B] int32
     *,
     n_kv_heads: int,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     b, h, dh = q.shape
@@ -231,6 +242,7 @@ def paged_attention_pallas(
         head_dim=dh,
         page_size=page_size,
         n_heads=h,
+        window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -253,6 +265,7 @@ def paged_attention(
     *,
     n_kv_heads: int,
     impl: str = "auto",
+    window: int = 0,
 ) -> jnp.ndarray:
     """impl: "auto" (pallas on TPU, xla elsewhere) | "xla" | "pallas" |
     "pallas_interpret" (kernel correctness tests on CPU)."""
@@ -260,11 +273,13 @@ def paged_attention(
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return paged_attention_xla(
-            q, k_pages, v_pages, page_table, lengths, n_kv_heads=n_kv_heads
+            q, k_pages, v_pages, page_table, lengths, n_kv_heads=n_kv_heads,
+            window=window,
         )
     if impl in ("pallas", "pallas_interpret"):
         return paged_attention_pallas(
             q, k_pages, v_pages, page_table, lengths,
-            n_kv_heads=n_kv_heads, interpret=impl == "pallas_interpret",
+            n_kv_heads=n_kv_heads, window=window,
+            interpret=impl == "pallas_interpret",
         )
     raise ValueError(f"unknown paged-attention impl {impl!r}")
