@@ -96,6 +96,10 @@ class EngineConfig:
     use_paged_kv: bool = False
     attention_impl: str = "auto"       # "auto" | "xla" | "pallas"
     prefix_cache: bool = True          # reuse full KV pages across shared prompt prefixes
+    prefill_chunk: int = 0             # continuous engine: prompts longer than
+                                       # this prefill in chunks interleaved with
+                                       # decode (0 = whole-prompt prefill);
+                                       # rounded to a multiple of page_size
 
 
 @dataclass

@@ -77,12 +77,35 @@ class _Slot:
         self.streamed = 0               # tokens already emitted to the cb
 
 
+class _PrefillProgress:
+    """A long prompt mid-way through chunked prefill: its slot and pages are
+    allocated, but it is not yet decoding (not in ``_slots``)."""
+
+    __slots__ = ("request", "prompt", "done", "on_tokens", "t_submit")
+
+    def __init__(self, request: GenerationRequest, prompt: List[int],
+                 on_tokens, t_submit: float) -> None:
+        self.request = request
+        self.prompt = prompt
+        self.done = 0                   # tokens already prefilled (page-aligned)
+        self.on_tokens = on_tokens
+        self.t_submit = t_submit
+
+
 class ContinuousEngine:
     """Slot-based continuous batching over a paged KV cache.
 
     Synchronous pump: callers enqueue with ``submit`` and drive ``step()``
     (or ``run_until_idle``); the async serving layer wraps this in its
     executor thread exactly like ``Engine.generate``.
+
+    With ``EngineConfig.prefill_chunk`` set, prompts longer than the chunk
+    prefill incrementally — one chunk per engine step, interleaved with
+    decode chunks — so admitting a long prompt stalls live decodes for one
+    bounded chunk instead of the whole prompt (the inter-token-latency
+    cliff SURVEY.md §7 hard-part #3 describes; chunked prefill is the
+    single-pool alternative to disaggregation, which ``engine/disagg.py``
+    provides for two pools).
     """
 
     def __init__(
@@ -123,6 +146,13 @@ class ContinuousEngine:
         self.prefix_cache = bool(cfg.prefix_cache)
         self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
         self._prefix_hit_admissions = 0
+        # chunked prefill: chunk must be page-aligned so every suffix chunk
+        # starts on a page boundary (the context gather reads whole pages)
+        ps = self.kv.page_size
+        self._chunk = (max(ps, cfg.prefill_chunk // ps * ps)
+                       if cfg.prefill_chunk else 0)
+        self._prefilling: Dict[int, _PrefillProgress] = {}   # slot -> progress
+        self._chunked_admissions = 0
 
         # ---- queues / state: (request, stream cb or None, t_submit)
         self._waiting: Deque[Tuple[GenerationRequest, Any, float]] = (
@@ -452,19 +482,34 @@ class ContinuousEngine:
                     self._admission_denied += 1
                     break
                 slot, n_cached = got
-                if n_cached == 0:
-                    hr = self.kv.first_page_hash(prompt, registerable=True)
-                    if hr is not None:
-                        pending_hashes.add(hr)
             else:
                 slot = self.kv.alloc_slot(len(prompt))
                 n_cached = 0
                 if slot is None:
                     self._admission_denied += 1
                     break
+            # chunk whenever the UNCACHED portion exceeds the chunk — a
+            # prefix-cache hit with a long unique tail stalls decode just
+            # as hard as a cache miss
+            will_chunk = (self._chunk
+                          and len(prompt) - n_cached > self._chunk)
+            if self.prefix_cache and n_cached == 0 and not will_chunk:
+                # a chunked admission registers its prefix only after its
+                # LAST chunk, many steps from now — advertising its hash
+                # would trigger pointless flushes that register nothing
+                hr = self.kv.first_page_hash(prompt, registerable=True)
+                if hr is not None:
+                    pending_hashes.add(hr)
             self._waiting.popleft()
             admitted += 1
-            if n_cached > 0:
+            if will_chunk:
+                # long uncached span: prefill incrementally between decode
+                # chunks, resuming after any cached prefix
+                if n_cached > 0:
+                    self._prefix_hit_admissions += 1
+                self._start_chunked(req, on_tok, slot, prompt, t_submit,
+                                    done=n_cached)
+            elif n_cached > 0:
                 t0 = time.perf_counter()
                 sampling = SamplingParams(
                     jnp.asarray([req.temperature], jnp.float32),
@@ -541,24 +586,23 @@ class ContinuousEngine:
                 rows.append(self._slot_row(req, slot, len(prompt), first))
         self._install_device(rows)
 
-    def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int,
-                               sampling, key):
-        """Prefix-cache-hit admission: run the jitted suffix prefill over
-        the uncached tail, write its KV at offset ``n_cached``, return the
-        sampled first token (device [1]). ``n_cached`` is a whole number
-        of pages and < len(prompt) (``PagedKVCache.alloc_slot_prefix``)."""
-        suffix = prompt[n_cached:]
+    def _run_suffix_prefill(self, suffix, slot: int, n_ctx_tokens: int,
+                            sampling, key):
+        """Run the jitted suffix-prefill over ``suffix`` with
+        ``n_ctx_tokens`` already sitting in the slot's pages (page-aligned),
+        write the fresh KV at that offset, and return the sampled next
+        token (device [1]). Shared by prefix-cache hits and chunked
+        prefill — both are "continue a partially prefilled sequence"."""
         tb = _next_bucket(len(suffix), self.prefill_buckets)
         tokens = np.zeros((1, tb), np.int32)
         tokens[0, : len(suffix)] = suffix
         suffix_lens = jnp.asarray([len(suffix)], jnp.int32)
-        n_ctx = jnp.asarray([n_cached], jnp.int32)
-        ctx_pages = n_cached // self.kv.page_size
+        n_ctx = jnp.asarray([n_ctx_tokens], jnp.int32)
+        ctx_pages = n_ctx_tokens // self.kv.page_size
         mpb = _next_bucket(ctx_pages, self._ctx_page_buckets)
         phys = jnp.asarray(
             np.ascontiguousarray(self.kv._table[slot, :mpb]), jnp.int32
         )
-        self._prefix_hit_admissions += 1
         first_dev, ks, vs = self._prefill_suffix(
             self.params, jnp.asarray(tokens), suffix_lens, n_ctx, phys,
             self.kv.k_pages, self.kv.v_pages, sampling, key,
@@ -570,6 +614,76 @@ class ContinuousEngine:
         )
         self.kv.swap(kp, vp)
         return first_dev
+
+    def _prefill_cached_suffix(self, prompt, slot: int, n_cached: int,
+                               sampling, key):
+        """Prefix-cache-hit admission: prefill only the uncached tail.
+        ``n_cached`` is a whole number of pages and < len(prompt)
+        (``PagedKVCache.alloc_slot_prefix``)."""
+        self._prefix_hit_admissions += 1
+        return self._run_suffix_prefill(prompt[n_cached:], slot, n_cached,
+                                        sampling, key)
+
+    # ----------------------------------------------------- chunked prefill
+
+    def _start_chunked(self, req: GenerationRequest, on_tokens, slot: int,
+                       prompt: List[int], t_submit: float,
+                       done: int = 0) -> None:
+        """Begin incremental prefill of a long prompt: the slot and its
+        pages are reserved now; chunks run one per engine step. ``done``
+        > 0 resumes after a prefix-cache hit (page-aligned)."""
+        self._chunked_admissions += 1
+        prog = _PrefillProgress(req, prompt, on_tokens, t_submit)
+        prog.done = done
+        self._prefilling[slot] = prog
+
+    def _advance_chunked(self) -> None:
+        """Prefill ONE chunk of the oldest in-progress long prompt. One
+        chunk per step bounds how long a decode round can be stalled by
+        prompt processing, which is the whole point of chunking."""
+        if not self._prefilling:
+            return
+        slot, prog = next(iter(self._prefilling.items()))   # FIFO
+        req = prog.request
+        chunk = prog.prompt[prog.done: prog.done + self._chunk]
+        is_last = prog.done + len(chunk) >= len(prog.prompt)
+        t0 = time.perf_counter()
+        sampling = SamplingParams(
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+        )
+        self._rng, k0 = jax.random.split(self._rng)
+        if prog.done == 0:
+            tb = _next_bucket(len(chunk), self.prefill_buckets)
+            tokens = np.zeros((1, tb), np.int32)
+            tokens[0, : len(chunk)] = chunk
+            seq = jnp.asarray([len(chunk)], jnp.int32)
+            first_dev, ks, vs = self._prefill(
+                self.params, jnp.asarray(tokens), seq, sampling, k0)
+            kp, vp = self._write_pages(
+                self.kv.k_pages, self.kv.v_pages, ks, vs,
+                self.kv.page_table[slot: slot + 1], seq)
+            self.kv.swap(kp, vp)
+        else:
+            first_dev = self._run_suffix_prefill(chunk, slot, prog.done,
+                                                 sampling, k0)
+        prog.done += len(chunk)
+        self._prefill_calls += 1
+        self.prefill_stats.add(time.perf_counter() - t0)
+        if is_last:
+            del self._prefilling[slot]
+            if self.prefix_cache:
+                self.kv.register_prefix(slot, prog.prompt)
+            self._total_prompt_tokens += len(prog.prompt)
+            # only the LAST chunk's sample is the real first token (earlier
+            # chunks' samples are discarded — their logits see a truncated
+            # prompt)
+            first = int(np.asarray(first_dev)[0])
+            if self._register_slot_host(req, slot, len(prog.prompt), first,
+                                        prog.t_submit, prog.on_tokens):
+                self._install_device(
+                    [self._slot_row(req, slot, len(prog.prompt), first)])
 
     # ---------------------------------------------------------- streaming
 
@@ -618,11 +732,13 @@ class ContinuousEngine:
     # --------------------------------------------------------------- step
 
     def step(self) -> int:
-        """One engine iteration: admit, then one decode chunk. Returns the
-        number of live slots after the iteration."""
+        """One engine iteration: admit, advance one prefill chunk, then one
+        decode chunk. Returns live + mid-prefill slots after the
+        iteration."""
         self._try_admit()
+        self._advance_chunked()
         if not self._slots:
-            return 0
+            return len(self._prefilling)
         self._steps += 1
         self._occupancy_sum += len(self._slots)   # batch occupancy metric
 
@@ -641,7 +757,7 @@ class ContinuousEngine:
                 n_steps = min(n_steps, cap_tok - cur)
 
         if not self._slots or n_steps <= 0:
-            return len(self._slots)
+            return len(self._slots) + len(self._prefilling)
 
         t0 = time.perf_counter()
         cap = jnp.asarray(
@@ -674,7 +790,7 @@ class ContinuousEngine:
                 reason = ("stop" if req.eos_id >= 0 and
                           req.eos_id in state.tokens else "length")
                 self._finish(slot, reason)
-        return len(self._slots)
+        return len(self._slots) + len(self._prefilling)
 
     def _deactivate(self, slot: int) -> None:
         self._active = self._active.at[slot].set(False)
@@ -705,11 +821,14 @@ class ContinuousEngine:
         return their pages to the pool. Recovery hook for the pump when a
         decode step fails irrecoverably."""
         n = (len(self._waiting) + len(self._waiting_prefilled)
-             + len(self._slots))
+             + len(self._slots) + len(self._prefilling))
         self._waiting.clear()
         self._waiting_prefilled.clear()
         for slot in list(self._slots):
             self._slots.pop(slot)
+            self.kv.free_slot(slot)
+        for slot in list(self._prefilling):
+            self._prefilling.pop(slot)
             self.kv.free_slot(slot)
         self._active = jnp.zeros_like(self._active)
         return n
@@ -720,7 +839,10 @@ class ContinuousEngine:
 
     @property
     def n_live(self) -> int:
-        return len(self._slots)
+        # mid-chunked-prefill sequences hold slots/pages and need further
+        # step() calls: callers gating their pump loop on n_live (e.g.
+        # serving/pump.py) must see them or the engine stalls mid-prompt
+        return len(self._slots) + len(self._prefilling)
 
     # ------------------------------------------------------------ metrics
 
@@ -736,6 +858,8 @@ class ContinuousEngine:
             "engine_steps": self._steps,
             "prefill_calls": self._prefill_calls,
             "prefix_hit_admissions": self._prefix_hit_admissions,
+            "prefilling_slots": len(self._prefilling),
+            "chunked_admissions": self._chunked_admissions,
             # serving metrics the reference's mock could never know
             # (SURVEY.md §5): per-request TTFT from submit, and mean decode
             # batch occupancy (live slots / max_slots per engine step)

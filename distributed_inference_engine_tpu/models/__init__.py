@@ -118,7 +118,7 @@ def engine_from_config(cfg):
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",
               "attention_impl", "kv_dtype", "prefill_buckets",
-              "prefix_cache"):
+              "prefix_cache", "prefill_chunk"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
     spec_k = int(cfg.metadata.get("speculative", 0))
