@@ -267,7 +267,10 @@ class Coordinator:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
         eos_id: int = -1,
+        stop_ids: Optional[Sequence[int]] = None,
+        stop_sequences: Optional[Sequence[Sequence[int]]] = None,
         key: Optional[str] = None,
         request_id: Optional[str] = None,
         no_cache: bool = False,
@@ -302,7 +305,9 @@ class Coordinator:
         cache_key: Optional[Tuple] = None
         if cacheable:
             cache_key = (model, version, tuple(prompt), max_new_tokens,
-                         top_k, top_p, eos_id)
+                         top_k, top_p, min_p, eos_id,
+                         tuple(stop_ids or ()),
+                         tuple(tuple(sq) for sq in (stop_sequences or ())))
             hit = self.cache.get(cache_key)
             if hit is not None:
                 self._cache_hits += 1
@@ -326,7 +331,10 @@ class Coordinator:
             "temperature": temperature,
             "top_k": top_k,
             "top_p": top_p,
+            "min_p": min_p,
             "eos_id": eos_id,
+            "stop_ids": list(stop_ids or ()),
+            "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
             "request_id": request_id,
             "key": affinity,
         }
@@ -356,7 +364,10 @@ class Coordinator:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
         eos_id: int = -1,
+        stop_ids: Optional[Sequence[int]] = None,
+        stop_sequences: Optional[Sequence[Sequence[int]]] = None,
         key: Optional[str] = None,
         request_id: Optional[str] = None,
         text: Optional[str] = None,
@@ -395,7 +406,10 @@ class Coordinator:
         req = request_from_dict({
             "prompt": list(prompt), "max_new_tokens": max_new_tokens,
             "temperature": temperature, "top_k": top_k, "top_p": top_p,
-            "eos_id": eos_id, "request_id": request_id,
+            "min_p": min_p, "eos_id": eos_id,
+            "stop_ids": list(stop_ids or ()),
+            "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
+            "request_id": request_id,
         })
         delivered = 0
         cb = on_tokens or (lambda toks: None)

@@ -91,7 +91,10 @@ class CoordinatorServer(FramedServerMixin):
             temperature=float(msg.get("temperature", 0.0)),
             top_k=int(msg.get("top_k", 0)),
             top_p=float(msg.get("top_p", 1.0)),
+            min_p=float(msg.get("min_p", 0.0)),
             eos_id=int(msg.get("eos_id", -1)),
+            stop_ids=msg.get("stop_ids"),
+            stop_sequences=msg.get("stop_sequences"),
             key=msg.get("key"),
             request_id=msg.get("request_id"),
             no_cache=bool(msg.get("no_cache", False)),
@@ -112,7 +115,10 @@ class CoordinatorServer(FramedServerMixin):
             temperature=float(msg.get("temperature", 0.0)),
             top_k=int(msg.get("top_k", 0)),
             top_p=float(msg.get("top_p", 1.0)),
+            min_p=float(msg.get("min_p", 0.0)),
             eos_id=int(msg.get("eos_id", -1)),
+            stop_ids=msg.get("stop_ids"),
+            stop_sequences=msg.get("stop_sequences"),
             key=msg.get("key"),
             request_id=msg.get("request_id"),
         ))

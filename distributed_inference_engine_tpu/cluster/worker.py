@@ -71,8 +71,11 @@ def request_to_dict(r: GenerationRequest) -> Dict[str, Any]:
         "temperature": r.temperature,
         "top_k": r.top_k,
         "top_p": r.top_p,
+        "min_p": r.min_p,
         "request_id": r.request_id,
         "eos_id": r.eos_id,
+        "stop_ids": list(r.stop_ids),
+        "stop_sequences": [list(s) for s in r.stop_sequences],
     }
 
 
@@ -83,8 +86,12 @@ def request_from_dict(d: Dict[str, Any]) -> GenerationRequest:
         temperature=float(d.get("temperature", 0.0)),
         top_k=int(d.get("top_k", 0)),
         top_p=float(d.get("top_p", 1.0)),
+        min_p=float(d.get("min_p", 0.0)),
         request_id=str(d.get("request_id", "")),
         eos_id=int(d.get("eos_id", -1)),
+        stop_ids=[int(t) for t in d.get("stop_ids", [])],
+        stop_sequences=[[int(t) for t in s]
+                        for s in d.get("stop_sequences", [])],
     )
 
 

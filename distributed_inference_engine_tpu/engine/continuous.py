@@ -53,7 +53,12 @@ from ..ops.sampling import SamplingParams, sample_tokens
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache
-from .types import GenerationRequest, GenerationResult
+from .types import (
+    GenerationRequest,
+    GenerationResult,
+    find_stop_cut,
+    trim_at_stops,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -179,6 +184,7 @@ class ContinuousEngine:
         self._temps = jnp.zeros((n,), jnp.float32)
         self._top_k = jnp.zeros((n,), jnp.int32)
         self._top_p = jnp.ones((n,), jnp.float32)
+        self._min_p = jnp.zeros((n,), jnp.float32)
 
         # ---- jitted programs
         spec_ = self.spec
@@ -246,9 +252,9 @@ class ContinuousEngine:
             )
             return carry, toks
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
         def _install(lengths, last, active, produced, max_new, eos,
-                     temps, top_k, top_p, slots, vals):
+                     temps, top_k, top_p, min_p, slots, vals):
             """All per-slot state writes of a WHOLE admission round in ONE
             dispatch (eager .at[].set chains are device round-trips —
             ruinous on remote/tunnelled devices). ``slots`` is a padded
@@ -266,6 +272,7 @@ class ContinuousEngine:
                 temps.at[i].set(vals["temp"], **kw),
                 top_k.at[i].set(vals["top_k"], **kw),
                 top_p.at[i].set(vals["top_p"], **kw),
+                min_p.at[i].set(vals["min_p"], **kw),
             )
 
         # page-pool writes donate the pool: an un-donated eager scatter
@@ -396,11 +403,9 @@ class ContinuousEngine:
         # (batched admission would otherwise count one wall time N times)
         self._emit_stream(state)
 
-        done = (req.eos_id >= 0 and first == req.eos_id) or \
-            req.max_new_tokens <= 1
-        if done:
-            self._finish(slot, "stop" if req.eos_id >= 0 and
-                         first == req.eos_id else "length")
+        _, stopped = trim_at_stops([first], req)
+        if stopped or req.max_new_tokens <= 1:
+            self._finish(slot, "stop" if stopped else "length")
             return False
         return True
 
@@ -416,17 +421,17 @@ class ContinuousEngine:
             ("prompt_len", np.int32), ("first", np.int32),
             ("max_new", np.int32), ("eos", np.int32),
             ("temp", np.float32), ("top_k", np.int32),
-            ("top_p", np.float32))}
+            ("top_p", np.float32), ("min_p", np.float32))}
         for i, r in enumerate(rows):
             slots[i] = r["slot"]
             for k in f:
                 f[k][i] = r[k]
         (self._lengths, self._last, self._active, self._produced,
          self._max_new, self._eos, self._temps, self._top_k,
-         self._top_p) = self._install(
+         self._top_p, self._min_p) = self._install(
             self._lengths, self._last, self._active, self._produced,
             self._max_new, self._eos, self._temps, self._top_k,
-            self._top_p, jnp.asarray(slots),
+            self._top_p, self._min_p, jnp.asarray(slots),
             {k: jnp.asarray(v) for k, v in f.items()},
         )
 
@@ -436,7 +441,7 @@ class ContinuousEngine:
         return {"slot": slot, "prompt_len": prompt_len, "first": first,
                 "max_new": req.max_new_tokens, "eos": req.eos_id,
                 "temp": req.temperature, "top_k": req.top_k,
-                "top_p": req.top_p}
+                "top_p": req.top_p, "min_p": req.min_p}
 
     def _install_slot(self, req: GenerationRequest, slot: int,
                       prompt_len: int, first: int, t_dispatch: float,
@@ -515,6 +520,7 @@ class ContinuousEngine:
                     jnp.asarray([req.temperature], jnp.float32),
                     jnp.asarray([req.top_k], jnp.int32),
                     jnp.asarray([req.top_p], jnp.float32),
+                    jnp.asarray([req.min_p], jnp.float32),
                 )
                 self._rng, k0 = jax.random.split(self._rng)
                 first_dev = self._prefill_cached_suffix(
@@ -553,6 +559,7 @@ class ContinuousEngine:
         temps = np.zeros((bb,), np.float32)
         top_k = np.zeros((bb,), np.int32)
         top_p = np.ones((bb,), np.float32)
+        min_p = np.zeros((bb,), np.float32)
         table_rows = np.zeros((bb, self.kv.max_pages_per_seq), np.int32)
         for i, (req, _cb, slot, prompt, _ts) in enumerate(batch):
             tokens[i, : len(prompt)] = prompt
@@ -560,9 +567,10 @@ class ContinuousEngine:
             temps[i] = req.temperature
             top_k[i] = req.top_k
             top_p[i] = req.top_p
+            min_p[i] = req.min_p
             table_rows[i] = self.kv._table[slot]
         sampling = SamplingParams(jnp.asarray(temps), jnp.asarray(top_k),
-                                  jnp.asarray(top_p))
+                                  jnp.asarray(top_p), jnp.asarray(min_p))
         self._rng, k0 = jax.random.split(self._rng)
         seq_dev = jnp.asarray(seq_lens)
         first_dev, ks, vs = self._prefill(
@@ -652,6 +660,7 @@ class ContinuousEngine:
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
             jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.min_p], jnp.float32),
         )
         self._rng, k0 = jax.random.split(self._rng)
         if prog.done == 0:
@@ -696,9 +705,7 @@ class ContinuousEngine:
         if cb is None:
             return
         req = state.request
-        toks = state.tokens[: req.max_new_tokens]
-        if req.eos_id >= 0 and req.eos_id in toks:
-            toks = toks[: toks.index(req.eos_id) + 1]
+        toks, _ = trim_at_stops(state.tokens, req)
         if len(toks) > state.streamed:
             fresh = toks[state.streamed:]
             state.streamed = len(toks)
@@ -715,9 +722,8 @@ class ContinuousEngine:
         state = self._slots.pop(slot)
         self.kv.free_slot(slot)
         req = state.request
-        toks = state.tokens[: req.max_new_tokens]
-        if req.eos_id >= 0 and req.eos_id in toks:
-            toks = toks[: toks.index(req.eos_id) + 1]
+        toks, stopped = trim_at_stops(state.tokens, req)
+        if stopped:
             reason = "stop"
         self._total_generated += len(toks)
         self._finished.append(GenerationResult(
@@ -765,7 +771,8 @@ class ContinuousEngine:
              if s in self._slots else 0
              for s in range(self.max_slots)], jnp.int32,
         )
-        sampling = SamplingParams(self._temps, self._top_k, self._top_p)
+        sampling = SamplingParams(self._temps, self._top_k, self._top_p,
+                                  self._min_p)
         self._rng, kc = jax.random.split(self._rng)
         carry, toks = self._decode_chunk(
             self.params, self.kv.k_pages, self.kv.v_pages,
@@ -782,14 +789,24 @@ class ContinuousEngine:
 
         for slot, state in list(self._slots.items()):
             col = toks_np[:, slot]
+            prev = len(state.tokens)           # first index not yet stop-checked
             state.tokens.extend(int(t) for t in col if t >= 0)
             state.produced = len(state.tokens)
             self._emit_stream(state)
+            req = state.request
             if not active_np[slot]:
-                req = state.request
                 reason = ("stop" if req.eos_id >= 0 and
                           req.eos_id in state.tokens else "length")
                 self._finish(slot, reason)
+            elif req.stop_ids or req.stop_sequences:
+                # host-side stops (multi-id / multi-token): the device loop
+                # only knows eos_id, so check after each chunk and retire
+                # the slot — scanning only the new window keeps detection
+                # O(total) across a generation; _finish trims exactly
+                cut = find_stop_cut(state.tokens, req, start=prev)
+                if 0 <= cut <= req.max_new_tokens:
+                    self._deactivate(slot)
+                    self._finish(slot, "stop")
         return len(self._slots) + len(self._prefilling)
 
     def _deactivate(self, slot: int) -> None:

@@ -185,6 +185,7 @@ class PrefillEngine:
         temps = np.zeros((bb,), dtype=np.float32)
         top_k = np.zeros((bb,), dtype=np.int32)
         top_p = np.ones((bb,), dtype=np.float32)
+        min_p = np.zeros((bb,), dtype=np.float32)
         for i, r in enumerate(requests):
             p = r.prompt[-min(tb, max_keep):]      # overlong: keep the tail
             tokens[i, : len(p)] = p
@@ -192,8 +193,10 @@ class PrefillEngine:
             temps[i] = r.temperature
             top_k[i] = r.top_k
             top_p[i] = r.top_p
+            min_p[i] = r.min_p
         sampling = SamplingParams(
-            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p)
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p),
         )
 
         t0 = time.perf_counter()

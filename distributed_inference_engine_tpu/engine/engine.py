@@ -42,7 +42,11 @@ from ..models.base import (
 )
 from ..ops.sampling import SamplingParams, sample_tokens
 from ..utils.tracing import LatencyStats
-from .types import GenerationRequest, GenerationResult  # noqa: F401  (re-export)
+from .types import (  # noqa: F401  (re-export)
+    GenerationRequest,
+    GenerationResult,
+    trim_at_stops,
+)
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -186,6 +190,7 @@ class Engine:
         temps = np.zeros((bb,), dtype=np.float32)
         top_k = np.zeros((bb,), dtype=np.int32)
         top_p = np.ones((bb,), dtype=np.float32)
+        min_p = np.zeros((bb,), dtype=np.float32)
         for i, r in enumerate(requests):
             p = r.prompt[-tb:]                          # clamp overlong prompts
             tokens[i, : len(p)] = p
@@ -195,8 +200,10 @@ class Engine:
             temps[i] = r.temperature
             top_k[i] = r.top_k
             top_p[i] = r.top_p
+            min_p[i] = r.min_p
         sampling = SamplingParams(
-            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p)
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p),
         )
 
         t0 = time.perf_counter()
@@ -254,10 +261,7 @@ class Engine:
 
         results = []
         for i, r in enumerate(requests):
-            toks = out_tokens[i][: r.max_new_tokens]
-            stopped = r.eos_id >= 0 and r.eos_id in toks
-            if stopped:
-                toks = toks[: toks.index(r.eos_id) + 1]
+            toks, stopped = trim_at_stops(out_tokens[i], r)
             self._total_prompt_tokens += len(r.prompt)
             self._total_generated_tokens += len(toks)
             results.append(

@@ -51,7 +51,7 @@ from ..models.base import (
 )
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
-from .types import GenerationRequest, GenerationResult
+from .types import GenerationRequest, GenerationResult, trim_at_stops
 
 logger = logging.getLogger(__name__)
 
@@ -244,12 +244,12 @@ class SpeculativeEngine:
             return []
         if min(len(r.prompt) for r in requests) < 1:
             raise ValueError("empty prompt")
-        if any(r.top_k > 0 or r.top_p < 1.0 for r in requests) and \
-                not self._warned_topk:
+        if any(r.top_k > 0 or r.top_p < 1.0 or r.min_p > 0.0
+               for r in requests) and not self._warned_topk:
             self._warned_topk = True
             logger.warning(
-                "speculative engine honors temperature only — top_k/top_p "
-                "on these requests are ignored (rejection sampling is "
+                "speculative engine honors temperature only — top_k/top_p/"
+                "min_p on these requests are ignored (rejection sampling is "
                 "exact for the temperature-adjusted distribution)")
         self._total_requests += len(requests)
         n = len(requests)
@@ -339,10 +339,7 @@ class SpeculativeEngine:
 
         results = []
         for i, r in enumerate(requests):
-            toks = out_tokens[i][: r.max_new_tokens]
-            stopped = r.eos_id >= 0 and r.eos_id in toks
-            if stopped:
-                toks = toks[: toks.index(r.eos_id) + 1]
+            toks, stopped = trim_at_stops(out_tokens[i], r)
             self._total_prompt_tokens += len(r.prompt)
             self._total_generated += len(toks)
             results.append(GenerationResult(

@@ -8,7 +8,7 @@ the device stack. ``engine.engine`` re-exports both names.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 
 @dataclass
@@ -20,8 +20,64 @@ class GenerationRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    min_p: float = 0.0                # keep tokens with p >= min_p * p_max
     request_id: str = ""
     eos_id: int = -1                  # -1: never stops early
+    # additional stop conditions, checked host-side (eos_id stays the fast
+    # device-side exit): any single id in stop_ids, or any exact token
+    # subsequence in stop_sequences, ends generation. The matched stop
+    # token/sequence is INCLUDED in the output (same contract as eos_id).
+    stop_ids: List[int] = field(default_factory=list)
+    stop_sequences: List[List[int]] = field(default_factory=list)
+
+
+def find_stop_cut(tokens: List[int], req: "GenerationRequest",
+                  start: int = 0) -> int:
+    """Earliest cut index (exclusive, stop INCLUDED) of any stop condition
+    — ``eos_id``, ``stop_ids``, or ``stop_sequences`` — or -1 if none.
+
+    ``start`` is a scan hint: the index of the first token not yet checked.
+    The scan rewinds by the longest stop sequence minus one so a match
+    spanning the boundary is still found — callers tracking a per-slot
+    checked offset get O(total) stop detection instead of rescanning from
+    zero after every decode chunk."""
+    stops = set(req.stop_ids or ())
+    if req.eos_id >= 0:
+        stops.add(req.eos_id)
+    seqs = [list(s) for s in (req.stop_sequences or ()) if s]
+    if not stops and not seqs:
+        return -1
+    max_len = max((len(s) for s in seqs), default=1)
+    begin = max(0, start - (max_len - 1))
+    cut = -1
+    if stops:
+        for i in range(begin, len(tokens)):
+            if tokens[i] in stops:
+                cut = i + 1
+                break
+    for seq in seqs:
+        n = len(seq)
+        for i in range(begin, len(tokens) - n + 1):
+            if tokens[i: i + n] == seq:
+                end = i + n
+                if cut < 0 or end < cut:
+                    cut = end
+                break
+    return cut
+
+
+def trim_at_stops(tokens: List[int], req: "GenerationRequest"
+                  ) -> Tuple[List[int], bool]:
+    """Cap at ``max_new_tokens`` and cut at the EARLIEST stop condition,
+    keeping the matched stop itself. Returns (trimmed tokens, stopped?).
+
+    One shared trimmer so the static, continuous, speculative, and
+    streaming paths cannot disagree about what the final output is."""
+    toks = list(tokens[: req.max_new_tokens])
+    cut = find_stop_cut(toks, req)
+    if cut >= 0:
+        return toks[:cut], True
+    return toks, False
 
 
 @dataclass

@@ -21,12 +21,21 @@ class SamplingParams(NamedTuple):
     temperature: jnp.ndarray   # 0.0 => greedy
     top_k: jnp.ndarray         # 0 or >= vocab => disabled
     top_p: jnp.ndarray         # 1.0 => disabled
+    min_p: jnp.ndarray = None  # 0.0 => disabled; keep p >= min_p * p_max
 
     @classmethod
-    def make(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0) -> "SamplingParams":
+    def make(cls, batch: int, temperature=0.0, top_k=0, top_p=1.0,
+             min_p=0.0) -> "SamplingParams":
         full = lambda v, dt: jnp.full((batch,), v, dtype=dt)
         return cls(full(temperature, jnp.float32), full(top_k, jnp.int32),
-                   full(top_p, jnp.float32))
+                   full(top_p, jnp.float32), full(min_p, jnp.float32))
+
+    def min_p_or_zeros(self) -> jnp.ndarray:
+        """min_p defaults to None so older positional constructions keep
+        working; sampling treats None as disabled."""
+        if self.min_p is None:
+            return jnp.zeros_like(self.temperature)
+        return self.min_p
 
 
 def _mask_topk_topp(scaled: jnp.ndarray, params: SamplingParams
@@ -53,7 +62,18 @@ def _mask_topk_topp(scaled: jnp.ndarray, params: SamplingParams
     cum_excl = cum - probs_sorted
     keep_sorted = cum_excl < params.top_p[:, None]
     keep_topp = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
-    return jnp.where(keep_topk & keep_topp, scaled, -jnp.inf)
+
+    # ---- min-p mask: keep tokens whose tempered prob is at least
+    # min_p * max prob. Reuses the sorted softmax above: p_max is its first
+    # column and per-token probs come back through the same ranks gather —
+    # no second softmax on the decode hot path. Clamped to [0, 1]: an
+    # out-of-range client value must not mask the argmax itself (min_p>1
+    # would -inf the whole row and sample uniform noise).
+    minp = jnp.clip(params.min_p_or_zeros(), 0.0, 1.0)
+    probs = jnp.take_along_axis(probs_sorted, ranks, axis=-1)
+    keep_minp = (minp[:, None] <= 0.0) | \
+        (probs >= minp[:, None] * probs_sorted[:, :1])
+    return jnp.where(keep_topk & keep_topp & keep_minp, scaled, -jnp.inf)
 
 
 def sample_tokens(
@@ -81,7 +101,8 @@ def sample_tokens(
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    needs_mask = jnp.any(params.top_k > 0) | jnp.any(params.top_p < 1.0)
+    needs_mask = (jnp.any(params.top_k > 0) | jnp.any(params.top_p < 1.0)
+                  | jnp.any(params.min_p_or_zeros() > 0.0))
     masked = jax.lax.cond(
         needs_mask,
         lambda s: _mask_topk_topp(s, params),

@@ -1,0 +1,186 @@
+"""Stop conditions beyond a single eos_id (stop_ids, multi-token
+stop_sequences) and min-p sampling.
+
+The reference never had token-space semantics at all (its model echoes
+opaque blobs, SURVEY.md §0); these are serving-surface parity with
+production token samplers. One shared trimmer (``engine.types
+.trim_at_stops``) backs the static, continuous, speculative, and streaming
+paths so they cannot disagree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_inference_engine_tpu.config import EngineConfig
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.engine import Engine
+from distributed_inference_engine_tpu.engine.types import (
+    GenerationRequest,
+    trim_at_stops,
+)
+from distributed_inference_engine_tpu.models.llama import llama_spec
+from distributed_inference_engine_tpu.ops.sampling import (
+    SamplingParams,
+    sample_tokens,
+)
+
+SPEC = llama_spec("llama-tiny", max_seq_len=256).replace(dtype="float32")
+ECFG = dict(max_slots=2, max_seq_len=128, prefill_buckets=[16],
+            decode_steps_per_call=4, page_size=16, num_pages=24)
+
+
+# ------------------------------------------------------------ trim helper
+
+
+def _req(**kw):
+    kw.setdefault("prompt", [1])
+    return GenerationRequest(**kw)
+
+
+def test_trim_eos_and_stop_ids_earliest_wins():
+    toks = [5, 9, 7, 3, 7, 2]
+    out, stopped = trim_at_stops(toks, _req(max_new_tokens=10, eos_id=2))
+    assert out == toks and stopped                       # eos at the end
+    out, stopped = trim_at_stops(toks, _req(max_new_tokens=10, eos_id=2,
+                                            stop_ids=[7]))
+    assert out == [5, 9, 7] and stopped                  # earliest stop wins
+    out, stopped = trim_at_stops(toks, _req(max_new_tokens=10))
+    assert out == toks and not stopped
+
+
+def test_trim_stop_sequences_inclusive_and_earliest():
+    toks = [5, 9, 7, 3, 7, 2]
+    out, stopped = trim_at_stops(
+        toks, _req(max_new_tokens=10, stop_sequences=[[7, 3]]))
+    assert out == [5, 9, 7, 3] and stopped
+    # a sequence beating a later stop id
+    out, stopped = trim_at_stops(
+        toks, _req(max_new_tokens=10, stop_ids=[2], stop_sequences=[[9, 7]]))
+    assert out == [5, 9, 7] and stopped
+    # max_new cap applies before matching
+    out, stopped = trim_at_stops(
+        toks, _req(max_new_tokens=2, stop_ids=[7]))
+    assert out == [5, 9] and not stopped
+    # empty sequences are ignored
+    out, stopped = trim_at_stops(toks, _req(max_new_tokens=10,
+                                            stop_sequences=[[]]))
+    assert out == toks and not stopped
+
+
+# ------------------------------------------------------- engine stop paths
+
+
+def test_static_engine_stop_ids_and_sequences():
+    eng = Engine(SPEC, config=EngineConfig(**{k: v for k, v in ECFG.items()
+                                              if k not in ("page_size",
+                                                           "num_pages")}))
+    base = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                           max_new_tokens=12)])[0].tokens
+    assert len(base) == 12
+    stop_tok = base[4]
+    first_idx = base.index(stop_tok)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3], max_new_tokens=12,
+                                          stop_ids=[stop_tok])])[0]
+    assert out.tokens == base[: first_idx + 1]
+    assert out.finish_reason == "stop"
+    seq = base[2:4]
+    out2 = eng.generate([GenerationRequest(prompt=[1, 2, 3], max_new_tokens=12,
+                                           stop_sequences=[seq])])[0]
+    assert out2.tokens == base[:4] and out2.finish_reason == "stop"
+
+
+def test_continuous_engine_stops_retire_slots_early():
+    eng = ContinuousEngine(SPEC, config=EngineConfig(**ECFG), seed=0)
+    base = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                           max_new_tokens=24)])[0].tokens
+    stop_tok = base[6]
+    first_idx = base.index(stop_tok)
+    got = []
+    eng2 = ContinuousEngine(SPEC, params=eng.params,
+                            config=EngineConfig(**ECFG))
+    eng2.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=24,
+                                  stop_ids=[stop_tok]), on_tokens=got.extend)
+    res = eng2.run_until_idle()[0]
+    assert res.tokens == base[: first_idx + 1]
+    assert res.finish_reason == "stop"
+    assert got == res.tokens                 # stream never overshoots the stop
+    # early retirement: far fewer tokens were generated than max_new
+    assert eng2.get_metrics()["total_generated_tokens"] == len(res.tokens)
+
+
+# ----------------------------------------------------------------- min-p
+
+
+def test_min_p_restricts_support():
+    # hand-built logits: probs ~ [0.5, 0.25, 0.125, ...] over 8 tokens
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.05, 0.02, 0.02,
+                                   0.005, 0.005]], jnp.float32))
+    params = SamplingParams.make(1, temperature=1.0, min_p=0.6)
+    # p >= 0.6 * 0.4 = 0.24 -> only tokens 0 and 1 survive
+    seen = set()
+    for i in range(64):
+        tok = int(sample_tokens(logits, params, jax.random.key(i))[0])
+        seen.add(tok)
+    assert seen <= {0, 1} and len(seen) == 2
+    # min_p=0 leaves the tail reachable
+    params0 = SamplingParams.make(1, temperature=1.0, min_p=0.0)
+    seen0 = {int(sample_tokens(logits, params0, jax.random.key(i))[0])
+             for i in range(256)}
+    assert len(seen0) > 2
+
+
+def test_min_p_defaults_keep_greedy_identical():
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 32), jnp.float32)
+    greedy_old = sample_tokens(
+        logits, SamplingParams(jnp.zeros((4,)), jnp.zeros((4,), jnp.int32),
+                               jnp.ones((4,))), jax.random.key(0))
+    greedy_new = sample_tokens(
+        logits, SamplingParams.make(4, temperature=0.0, min_p=0.0),
+        jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(greedy_old),
+                                  np.asarray(greedy_new))
+    np.testing.assert_array_equal(np.asarray(greedy_old),
+                                  np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+def test_min_p_flows_through_engine():
+    """With min_p=1.0 and temperature>0 only the argmax survives the mask,
+    so sampled output must equal greedy output."""
+    cfg = EngineConfig(**{k: v for k, v in ECFG.items()
+                          if k not in ("page_size", "num_pages")})
+    eng = Engine(SPEC, config=cfg, seed=0)
+    greedy = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                             max_new_tokens=10)])[0].tokens
+    sampled = eng.generate([GenerationRequest(
+        prompt=[1, 2, 3], max_new_tokens=10, temperature=0.8,
+        min_p=1.0)])[0].tokens
+    assert sampled == greedy
+
+
+# ------------------------------------------------------------------ wire
+
+
+def test_request_wire_roundtrip_preserves_new_fields():
+    from distributed_inference_engine_tpu.cluster.worker import (
+        request_from_dict,
+        request_to_dict,
+    )
+
+    r = GenerationRequest(prompt=[1, 2], max_new_tokens=5, min_p=0.25,
+                          stop_ids=[7, 9], stop_sequences=[[1, 2], [3]])
+    d = request_to_dict(r)
+    r2 = request_from_dict(d)
+    assert r2.min_p == 0.25
+    assert r2.stop_ids == [7, 9]
+    assert r2.stop_sequences == [[1, 2], [3]]
+
+
+def test_min_p_out_of_range_is_clamped_not_noise():
+    """min_p > 1 from a client must not -inf the whole row (which would
+    sample uniform vocabulary noise); clamping keeps at least the argmax."""
+    logits = jnp.log(jnp.asarray([[0.7, 0.2, 0.05, 0.05]], jnp.float32))
+    params = SamplingParams.make(1, temperature=1.0, min_p=5.0)
+    toks = {int(sample_tokens(logits, params, jax.random.key(i))[0])
+            for i in range(32)}
+    assert toks == {0}
