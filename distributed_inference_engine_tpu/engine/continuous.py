@@ -67,7 +67,8 @@ class _Slot:
     """Host-side bookkeeping for one live sequence."""
 
     __slots__ = ("request", "slot_id", "prompt_len", "produced", "tokens",
-                 "admitted_at", "first_token_at", "on_tokens", "streamed")
+                 "admitted_at", "first_token_at", "on_tokens", "streamed",
+                 "stop_cut")
 
     def __init__(self, request: GenerationRequest, slot_id: int,
                  prompt_len: int, on_tokens=None) -> None:
@@ -80,6 +81,7 @@ class _Slot:
         self.first_token_at = 0.0
         self.on_tokens = on_tokens      # streaming: cb(new_tokens: List[int])
         self.streamed = 0               # tokens already emitted to the cb
+        self.stop_cut = -1              # earliest stop cut, once found
 
 
 class _PrefillProgress:
@@ -185,6 +187,12 @@ class ContinuousEngine:
         self._top_k = jnp.zeros((n,), jnp.int32)
         self._top_p = jnp.ones((n,), jnp.float32)
         self._min_p = jnp.zeros((n,), jnp.float32)
+        # host mirror of per-slot lengths: the capacity loop consults it
+        # every step, and a device readback costs a full round trip
+        # (~100 ms on tunnelled/remote devices). Updated on admission and
+        # from each chunk's packed output row. (Active flags need no
+        # mirror — each chunk's packed row is consumed immediately.)
+        self._lengths_host = np.zeros((n,), np.int32)
 
         # ---- jitted programs
         spec_ = self.spec
@@ -250,7 +258,13 @@ class ContinuousEngine:
             carry, toks = jax.lax.scan(
                 step, (kp, vp, lengths, last_tokens, active, produced), keys
             )
-            return carry, toks
+            # pack tokens + active flags + lengths into ONE output buffer:
+            # the host makes exactly one blocking read per chunk (each sync
+            # is a full round trip on remote devices)
+            packed = jnp.concatenate(
+                [toks, carry[4][None].astype(jnp.int32), carry[2][None]],
+                axis=0)
+            return carry, packed
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
         def _install(lengths, last, active, produced, max_new, eos,
@@ -403,9 +417,9 @@ class ContinuousEngine:
         # (batched admission would otherwise count one wall time N times)
         self._emit_stream(state)
 
-        _, stopped = trim_at_stops([first], req)
-        if stopped or req.max_new_tokens <= 1:
-            self._finish(slot, "stop" if stopped else "length")
+        state.stop_cut = find_stop_cut([first], req)
+        if state.stop_cut >= 0 or req.max_new_tokens <= 1:
+            self._finish(slot, "stop" if state.stop_cut >= 0 else "length")
             return False
         return True
 
@@ -424,6 +438,7 @@ class ContinuousEngine:
             ("top_p", np.float32), ("min_p", np.float32))}
         for i, r in enumerate(rows):
             slots[i] = r["slot"]
+            self._lengths_host[r["slot"]] = r["prompt_len"]
             for k in f:
                 f[k][i] = r[k]
         (self._lengths, self._last, self._active, self._produced,
@@ -705,7 +720,11 @@ class ContinuousEngine:
         if cb is None:
             return
         req = state.request
-        toks, _ = trim_at_stops(state.tokens, req)
+        toks = state.tokens[: req.max_new_tokens]
+        if 0 <= state.stop_cut <= len(toks):
+            # cut found by the incremental scan (or first-token check) —
+            # no rescan of the whole history per chunk
+            toks = toks[: state.stop_cut]
         if len(toks) > state.streamed:
             fresh = toks[state.streamed:]
             state.streamed = len(toks)
@@ -751,7 +770,7 @@ class ContinuousEngine:
         # capacity: grow every active slot toward a full chunk; a slot that
         # can't even fit one more token is finished (pool pressure or cap)
         n_steps = self.config.decode_steps_per_call
-        lengths_np = np.asarray(self._lengths)
+        lengths_np = self._lengths_host
         for slot in list(self._slots):
             cur = int(lengths_np[slot])
             cap_tok = self.kv.ensure_capacity(slot, cur + n_steps)
@@ -774,7 +793,7 @@ class ContinuousEngine:
         sampling = SamplingParams(self._temps, self._top_k, self._top_p,
                                   self._min_p)
         self._rng, kc = jax.random.split(self._rng)
-        carry, toks = self._decode_chunk(
+        carry, packed = self._decode_chunk(
             self.params, self.kv.k_pages, self.kv.v_pages,
             self._lengths, self._last, self._active, self._produced,
             self.kv.page_table, cap, self._max_new, sampling, self._eos,
@@ -783,8 +802,10 @@ class ContinuousEngine:
         kp, vp, self._lengths, self._last, self._active, self._produced = carry
         self.kv.swap(kp, vp)
 
-        toks_np = np.asarray(toks)                       # [n_steps, max_slots]
-        active_np = np.asarray(self._active)
+        packed_np = np.asarray(packed)   # ONE blocking read per chunk
+        toks_np = packed_np[:-2]                         # [n_steps, max_slots]
+        active_np = packed_np[-2].astype(bool)
+        self._lengths_host = packed_np[-1].astype(np.int32)
         self.chunk_stats.add(time.perf_counter() - t0)
 
         for slot, state in list(self._slots.items()):
@@ -792,21 +813,24 @@ class ContinuousEngine:
             prev = len(state.tokens)           # first index not yet stop-checked
             state.tokens.extend(int(t) for t in col if t >= 0)
             state.produced = len(state.tokens)
-            self._emit_stream(state)
             req = state.request
+            has_stops = (req.eos_id >= 0 or req.stop_ids
+                         or req.stop_sequences)
+            if has_stops and state.stop_cut < 0:
+                # scan only the new window: O(total) stop detection across
+                # a generation, shared with the streaming emit below
+                state.stop_cut = find_stop_cut(state.tokens, req, start=prev)
+            self._emit_stream(state)
             if not active_np[slot]:
-                reason = ("stop" if req.eos_id >= 0 and
-                          req.eos_id in state.tokens else "length")
-                self._finish(slot, reason)
-            elif req.stop_ids or req.stop_sequences:
+                # _finish re-trims and upgrades the reason to "stop" when a
+                # stop condition is inside the cap
+                self._finish(slot, "length")
+            elif ((req.stop_ids or req.stop_sequences)
+                  and 0 <= state.stop_cut <= req.max_new_tokens):
                 # host-side stops (multi-id / multi-token): the device loop
-                # only knows eos_id, so check after each chunk and retire
-                # the slot — scanning only the new window keeps detection
-                # O(total) across a generation; _finish trims exactly
-                cut = find_stop_cut(state.tokens, req, start=prev)
-                if 0 <= cut <= req.max_new_tokens:
-                    self._deactivate(slot)
-                    self._finish(slot, "stop")
+                # only knows eos_id, so retire the slot here
+                self._deactivate(slot)
+                self._finish(slot, "stop")
         return len(self._slots) + len(self._prefilling)
 
     def _deactivate(self, slot: int) -> None:

@@ -147,7 +147,14 @@ class Engine:
             carry, toks = jax.lax.scan(
                 step, (ck, cv, lengths, last_tokens, active, produced), keys
             )
-            return carry, toks
+            # pack emitted tokens + live flags into ONE buffer: the host
+            # then makes exactly one blocking read per chunk. Each sync is
+            # a full round trip — ~100 ms on a tunnelled/remote device —
+            # so a separate active.any() readback would double the
+            # per-chunk overhead.
+            packed = jnp.concatenate(
+                [toks, carry[4][None].astype(jnp.int32)], axis=0)
+            return carry, packed
 
         self._prefill = _prefill
         self._decode_chunk = _decode_chunk
@@ -244,13 +251,20 @@ class Engine:
 
         t1 = time.perf_counter()
         n_steps = self.config.decode_steps_per_call
-        while bool(np.asarray(jax.device_get(active.any()))):
+        # loop condition runs on the HOST mirror of the active flags (seeded
+        # from the prefill sample, updated from each chunk's packed row) —
+        # a device-side active.any() would cost one extra round trip per
+        # chunk
+        act_host = active_np
+        while act_host.any():
             self._rng, kc = jax.random.split(self._rng)
-            (ck, cv, lengths, last, active, produced), toks = self._decode_chunk(
+            (ck, cv, lengths, last, active, produced), packed = self._decode_chunk(
                 self.params, ck, cv, lengths, last, active, produced,
                 max_new_j, sampling, eos_j, kc, n_steps=n_steps,
             )
-            toks_np = np.asarray(toks)                  # [n_steps, bb]
+            packed_np = np.asarray(packed)   # ONE blocking read per chunk
+            toks_np = packed_np[:-1]                    # [n_steps, bb]
+            act_host = packed_np[-1].astype(bool)
             for i in range(n):
                 for s in range(n_steps):
                     t = int(toks_np[s, i])
