@@ -625,6 +625,21 @@ def forward_train_aux(
     return unembed(spec, params, hidden), aux
 
 
+def next_token_xent(
+    logits: jnp.ndarray,     # [B, T, V] fp32
+    tokens: jnp.ndarray,     # [B, T]
+    seq_lens: jnp.ndarray,   # [B]
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over valid positions (shared by the
+    dense loss and the pipeline-parallel loss)."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    t = tokens.shape[1]
+    valid = (jnp.arange(t - 1)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
 def causal_lm_loss(
     spec: ModelSpec,
     params: Params,
@@ -635,12 +650,7 @@ def causal_lm_loss(
     """Mean next-token cross-entropy over valid positions, plus the MoE
     load-balance penalty when the spec routes experts."""
     logits, aux = forward_train_aux(spec, params, tokens, seq_lens)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    t = tokens.shape[1]
-    valid = (jnp.arange(t - 1)[None, :] < (seq_lens[:, None] - 1)).astype(jnp.float32)
-    loss = (nll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+    loss = next_token_xent(logits, tokens, seq_lens)
     if spec.n_experts:
         loss = loss + router_aux_coef * aux
     return loss

@@ -1,3 +1,9 @@
 from .mesh import make_mesh, factor_devices, AXIS_NAMES  # noqa: F401
 from .sharding import ModelShardings, shard_params, param_pspecs  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import (  # noqa: F401
+    make_pp_train_step,
+    pipeline_forward_train,
+    pipeline_lm_loss,
+    pp_param_pspecs,
+)
