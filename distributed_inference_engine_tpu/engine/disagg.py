@@ -117,6 +117,9 @@ class PrefillEngine:
         config: Optional[EngineConfig] = None,
         seed: int = 0,
         shard_fn=None,
+        sp_mesh=None,    # optional: sequence-parallel ring-attention
+                         # prefill (parallel/long_context.py) — the natural
+                         # fit for a long-prompt prefill pool
     ) -> None:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
@@ -141,10 +144,16 @@ class PrefillEngine:
         self.kv_dtype = jnp.dtype(cfg.kv_dtype)
 
         spec_ = self.spec
+        from ..parallel.long_context import prefill_fn_for
+        from .engine import _check_same_mesh
+
+        if sp_mesh is not None and shard_fn is not None:
+            _check_same_mesh(self.params, sp_mesh)
+        fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 
         @jax.jit
         def _prefill(params, tokens, seq_lens, sampling, key):
-            hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
+            hidden, ks, vs = fwd_prefill(spec_, params, tokens, seq_lens)
             b = tokens.shape[0]
             last = hidden[jnp.arange(b), seq_lens - 1]
             logits = unembed(spec_, params, last)

@@ -56,6 +56,19 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"size {n} exceeds largest bucket {max(buckets)}")
 
 
+def _check_same_mesh(params, sp_mesh) -> None:
+    """shard_fn + sp_mesh must agree on the mesh: params placed on one
+    mesh with activations constrained to another makes XLA reshard the
+    whole model across device orderings inside every prefill."""
+    leaf = jax.tree.leaves(params)[0]
+    mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+    if mesh is not None and mesh != sp_mesh:
+        raise ValueError(
+            "shard_fn placed params on a different mesh than sp_mesh — "
+            "cross-mesh prefill would reshard params every dispatch; "
+            "build both from the same Mesh")
+
+
 def _pow2_buckets(cap: int, start: int = 1) -> List[int]:
     out, b = [], start
     while b < cap:
@@ -81,6 +94,9 @@ class Engine:
         config: Optional[EngineConfig] = None,
         seed: int = 0,
         shard_fn=None,   # optional: fn(params) -> sharded params (parallel/)
+        sp_mesh=None,    # optional: mesh with a real sp axis — long prompts
+                         # prefill sequence-parallel via ring attention
+                         # (parallel/long_context.py); decode is unchanged
     ) -> None:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
@@ -102,10 +118,15 @@ class Engine:
 
         # ---- jitted programs (compiled per bucket shape, cached by jax)
         spec_ = self.spec
+        from ..parallel.long_context import prefill_fn_for
+
+        if sp_mesh is not None and shard_fn is not None:
+            _check_same_mesh(self.params, sp_mesh)
+        fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 
         @jax.jit
         def _prefill(params, tokens, seq_lens, sampling, key):
-            hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
+            hidden, ks, vs = fwd_prefill(spec_, params, tokens, seq_lens)
             b = tokens.shape[0]
             last = hidden[jnp.arange(b), seq_lens - 1]        # [B, D]
             logits = unembed(spec_, params, last)             # [B, V] fp32

@@ -220,8 +220,14 @@ class SpeculativeEngine:
             active = was_active & ~done
             lengths = jnp.where(was_active, lengths + n_acc + 1, lengths)
             last = jnp.where(was_active, final, last)
+            # pack emitted + n_acc + active into ONE output buffer: the
+            # host makes exactly one blocking read per round (each sync is
+            # a full round trip on tunnelled/remote devices)
+            packed = jnp.concatenate(
+                [emitted, n_acc[:, None], active.astype(jnp.int32)[:, None]],
+                axis=1)
             return (tck, tcv, dck, dcv, lengths, last,
-                    active, produced, emitted, n_acc)
+                    active, produced, packed)
 
         self._prefill_both = _prefill_both
         self._round = _round
@@ -317,18 +323,22 @@ class SpeculativeEngine:
         temps_j = jnp.asarray(temps)
 
         t1 = time.perf_counter()
-        while bool(np.asarray(jax.device_get(active.any()))):
+        act_host = active_np
+        while act_host.any():
             self._rng, kr = jax.random.split(self._rng)
             (tck, tcv, dck, dcv, lengths, last, active,
-             produced, emitted, n_acc) = self._round(
+             produced, packed) = self._round(
                 self.params, self.draft_params, tck, tcv, dck, dcv,
                 lengths, last, active, produced,
                 max_new_j, eos_j, temps_j, kr,
             )
-            em = np.asarray(emitted)
+            pk = np.asarray(packed)     # ONE blocking read per round
+            em = pk[:, : self.k + 1]
+            n_acc_np = pk[:, self.k + 1]
+            act_host = pk[:, self.k + 2].astype(bool)
             live = int((em[:, 0] >= 0).sum())
             self._total_rounds += 1
-            self._total_accepted += int(np.asarray(n_acc)[em[:, 0] >= 0].sum())
+            self._total_accepted += int(n_acc_np[em[:, 0] >= 0].sum())
             self._total_proposed += self.k * live
             for i in range(n):
                 for t in em[i]:

@@ -285,6 +285,26 @@ def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray
 # ------------------------------------------------------------------ prefill
 
 
+def transformer_block(
+    spec: ModelSpec,
+    blk: Params,
+    x: jnp.ndarray,          # [B, T, D]
+    positions: jnp.ndarray,  # [B, T]
+    attn_fn,                 # (q, k, v) -> attention output [B, T, H, Dh]
+    exact_moe: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One pre-norm block over fresh (non-cached) K/V: returns
+    (x_out, k, v, moe_aux). The single definition of the block math for
+    every full-sequence path — dense prefill, pipeline stages, and the
+    sequence-parallel prefill differ only in ``attn_fn``."""
+    h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+    q, k, v = _qkv(spec, blk, h, positions)
+    x = x + _out_proj(spec, blk, attn_fn(q, k, v))
+    h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+    m, aux = _mlp(spec, blk, h2, exact_moe=exact_moe)
+    return x + m, k, v, aux
+
+
 def forward_prefill(
     spec: ModelSpec,
     params: Params,
@@ -312,15 +332,13 @@ def _prefill_scan(
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
     x = embed(spec, params, tokens, positions)
 
-    def body(x, blk):
-        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
-        q, k, v = _qkv(spec, blk, h, positions)
-        attn = causal_attention(q, k, v, seq_lens,
+    def attn(q, k, v):
+        return causal_attention(q, k, v, seq_lens,
                                 window=spec.sliding_window)
-        x = x + _out_proj(spec, blk, attn)
-        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, aux = _mlp(spec, blk, h2, exact_moe=exact_moe)
-        x = x + m
+
+    def body(x, blk):
+        x, k, v, aux = transformer_block(spec, blk, x, positions, attn,
+                                         exact_moe=exact_moe)
         return x, (k, v, aux)
 
     x, (ks, vs, auxs) = lax.scan(body, x, params["blocks"])

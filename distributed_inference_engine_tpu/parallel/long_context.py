@@ -1,0 +1,99 @@
+"""Long-context serving: sequence-parallel prefill over the ``sp`` axis.
+
+SURVEY.md §5 (long-context row) and §7 step 7: nothing in the reference
+scales with sequence length, so this is the capability extension that makes
+long prompts first-class. Prefill is the phase that scales O(T²) — decode
+touches one token — so the serving integration shards the PROMPT over the
+``sp`` mesh axis: activations carry ``P(dp, sp, ·)``, every layer's
+attention runs as ring attention (``parallel/ring_attention.py`` —
+K/V blocks rotate over ICI with online softmax, HBM per chip stays
+O(T/sp)), and the resulting KV feeds the normal decode loop or a
+disaggregated handoff unchanged.
+
+Usage: pass ``sp_mesh`` to ``engine.Engine`` or ``engine.disagg
+.PrefillEngine`` — the jitted prefill swaps ``forward_prefill`` for
+``sp_forward_prefill``; nothing else in the serving stack changes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.base import (
+    ModelSpec,
+    Params,
+    embed,
+    transformer_block,
+)
+from .ring_attention import ring_attention
+
+
+def sp_forward_prefill(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,     # [B, T] right-padded prompts
+    seq_lens: jnp.ndarray,   # [B] true prompt lengths
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``models.base.forward_prefill`` with the sequence dim sharded over
+    ``sp`` and ring attention per layer. Same return contract:
+    (hidden [B, T, D], k_cache [L, B, T, Hkv, Dh], v_cache).
+    """
+    n_sp = mesh.shape["sp"]
+    b, t = tokens.shape
+    if t % n_sp:
+        raise ValueError(
+            f"prefill bucket {t} not divisible by sp={n_sp} — pick "
+            f"sp-aligned prefill_buckets")
+    if spec.sliding_window:
+        raise ValueError(
+            "sp prefill does not support sliding-window attention yet "
+            "(the ring schedule would need the window mask threaded "
+            "through the rotation)")
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    x = embed(spec, params, tokens, positions)
+    seq_sh = NamedSharding(mesh, P("dp", "sp", None))
+    x = lax.with_sharding_constraint(x, seq_sh)
+
+    def attn(q, k, v):
+        return ring_attention(q, k, v, mesh, seq_lens)
+
+    def body(x, blk):
+        x, k, v, _ = transformer_block(spec, blk, x, positions, attn)
+        x = lax.with_sharding_constraint(x, seq_sh)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    return x, ks, vs
+
+
+def prefill_fn_for(spec: ModelSpec, sp_mesh,
+                   prefill_buckets=None) -> "callable":
+    """Selector the engines use: the sp-sharded prefill when a mesh with a
+    real sp axis is supplied, the dense one otherwise. Both have the
+    signature (spec, params, tokens, seq_lens).
+
+    Validation runs HERE — at engine construction — not at first-request
+    trace time: a sliding-window spec or an sp-misaligned prefill bucket
+    must fail the deploy, not the first unlucky request."""
+    from ..models.base import forward_prefill
+
+    if sp_mesh is None or sp_mesh.shape.get("sp", 1) <= 1:
+        return forward_prefill
+    n_sp = sp_mesh.shape["sp"]
+    if spec.sliding_window:
+        raise ValueError(
+            "sp prefill does not support sliding-window attention yet "
+            "(the ring schedule would need the window mask threaded "
+            "through the rotation)")
+    for b in (prefill_buckets or ()):
+        if b % n_sp:
+            raise ValueError(
+                f"prefill bucket {b} not divisible by sp={n_sp} — pick "
+                f"sp-aligned prefill_buckets")
+    return lambda s, p, tok, lens: sp_forward_prefill(s, p, tok, lens,
+                                                      sp_mesh)

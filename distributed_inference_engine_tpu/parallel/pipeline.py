@@ -45,13 +45,10 @@ except ImportError:                                   # pragma: no cover
 from ..models.base import (
     ModelSpec,
     Params,
-    _mlp,
-    _norm,
-    _out_proj,
-    _qkv,
     embed,
     init_params,
     next_token_xent,
+    transformer_block,
     unembed,
 )
 from ..ops.attention import causal_attention
@@ -74,20 +71,18 @@ def pp_param_pspecs(spec: ModelSpec) -> Any:
 def _stage_body(spec: ModelSpec, blocks: Params, x: jnp.ndarray,
                 seq_lens: jnp.ndarray) -> jnp.ndarray:
     """Apply this stage's local layer stack to activations ``x``
-    ([mb, T, D]); same math as models.base._prefill_scan's body, without
-    KV collection (training/scoring path)."""
+    ([mb, T, D]) — ``models.base.transformer_block`` with the dense causal
+    attention, KV discarded (training/scoring path)."""
     b, t, _ = x.shape
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
 
-    def body(x, blk):
-        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
-        q, k, v = _qkv(spec, blk, h, positions)
-        attn = causal_attention(q, k, v, seq_lens,
+    def attn(q, k, v):
+        return causal_attention(q, k, v, seq_lens,
                                 window=spec.sliding_window)
-        x = x + _out_proj(spec, blk, attn)
-        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, _ = _mlp(spec, blk, h2)
-        return x + m, None
+
+    def body(x, blk):
+        x, _, _, _ = transformer_block(spec, blk, x, positions, attn)
+        return x, None
 
     x, _ = lax.scan(body, x, blocks)
     return x

@@ -43,12 +43,17 @@ def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int):
     qg = q.reshape(b, tl, n_kv_heads, g, dh)
     q_pos = idx * tl + jnp.arange(tl)                              # [Tl]
 
-    # online-softmax state per (batch, head-group, query); pvary marks the
-    # init as device-varying over the ring axis so the scan carry types match
-    # (the accumulators genuinely diverge per device from step 0)
-    m = lax.pvary(jnp.full((b, n_kv_heads, g, tl), NEG_INF, dtype=jnp.float32), axis)
-    l = lax.pvary(jnp.zeros((b, n_kv_heads, g, tl), dtype=jnp.float32), axis)
-    acc = lax.pvary(jnp.zeros((b, tl, n_kv_heads, g, dh), dtype=jnp.float32), axis)
+    # online-softmax state per (batch, head-group, query); marked
+    # device-varying over the ring axis so the loop carry types match (the
+    # accumulators genuinely diverge per device from step 0)
+    def _vary(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axis, to="varying")
+        return lax.pvary(x, axis)                     # older jax
+
+    m = _vary(jnp.full((b, n_kv_heads, g, tl), NEG_INF, dtype=jnp.float32))
+    l = _vary(jnp.zeros((b, n_kv_heads, g, tl), dtype=jnp.float32))
+    acc = _vary(jnp.zeros((b, tl, n_kv_heads, g, dh), dtype=jnp.float32))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

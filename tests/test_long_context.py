@@ -1,0 +1,119 @@
+"""Sequence-parallel long-context prefill (parallel/long_context.py):
+ring attention shards the prompt over the sp axis, feeding the unchanged
+decode loop / disaggregated handoff. SURVEY.md §5 long-context row —
+capability extension, held to exact-parity tests against the dense prefill
+on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import EngineConfig, MeshConfig
+from distributed_inference_engine_tpu.engine.engine import Engine
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.base import (
+    forward_prefill,
+    init_params,
+)
+from distributed_inference_engine_tpu.models.llama import llama_spec
+from distributed_inference_engine_tpu.models.mistral import mistral_spec
+from distributed_inference_engine_tpu.parallel.long_context import (
+    prefill_fn_for,
+    sp_forward_prefill,
+)
+from distributed_inference_engine_tpu.parallel.mesh import make_mesh
+
+SPEC = llama_spec("llama-tiny", max_seq_len=256).replace(dtype="float32")
+
+
+def _mesh(sp=4, dp=2):
+    return make_mesh(MeshConfig(dp=dp, sp=sp),
+                     devices=jax.devices()[: dp * sp])
+
+
+def test_sp_prefill_matches_dense():
+    mesh = _mesh()
+    params = init_params(SPEC, jax.random.key(0))
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(1, 1000, (2, 64)), jnp.int32)
+    lens = jnp.asarray([64, 40], jnp.int32)
+    h_ref, k_ref, v_ref = forward_prefill(SPEC, params, tokens, lens)
+    h_sp, k_sp, v_sp = sp_forward_prefill(SPEC, params, tokens, lens, mesh)
+    np.testing.assert_allclose(np.asarray(h_sp), np.asarray(h_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(k_sp), np.asarray(k_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(v_sp), np.asarray(v_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_engine_with_sp_mesh_matches_plain_engine():
+    """The serving contract: an sp-prefill engine produces token-identical
+    greedy output — the sequence sharding is an execution layout, not a
+    model change."""
+    mesh = _mesh()
+    cfg = EngineConfig(max_slots=2, max_seq_len=256, prefill_buckets=[64],
+                       decode_steps_per_call=8)
+    plain = Engine(SPEC, config=cfg, seed=0)
+    sp = Engine(SPEC, params=plain.params, config=cfg, sp_mesh=mesh)
+    prompt = list(range(1, 61))
+    a = plain.generate([GenerationRequest(prompt=list(prompt),
+                                          max_new_tokens=10)])[0]
+    b = sp.generate([GenerationRequest(prompt=list(prompt),
+                                       max_new_tokens=10)])[0]
+    assert a.tokens == b.tokens
+
+
+def test_prefill_engine_with_sp_mesh_handoff_parity():
+    from distributed_inference_engine_tpu.engine.disagg import PrefillEngine
+
+    mesh = _mesh()
+    cfg = EngineConfig(max_slots=2, max_seq_len=256, prefill_buckets=[64])
+    plain = PrefillEngine(SPEC, config=cfg, seed=0)
+    sp = PrefillEngine(SPEC, params=plain.params, config=cfg, sp_mesh=mesh)
+    req = GenerationRequest(prompt=list(range(1, 50)), max_new_tokens=4,
+                            request_id="h1")
+    h_plain = plain.prefill([req])[0]
+    h_sp = sp.prefill([req])[0]
+    assert h_sp.first_token == h_plain.first_token
+    assert h_sp.prompt_len == h_plain.prompt_len
+    np.testing.assert_allclose(
+        h_sp.k.astype(np.float32), h_plain.k.astype(np.float32),
+        rtol=2e-2, atol=2e-2)   # kv dtype is bf16
+
+
+def test_sp_prefill_rejects_misaligned_bucket_and_window():
+    mesh = _mesh()
+    params = init_params(SPEC, jax.random.key(0))
+    tokens = jnp.ones((1, 30), jnp.int32)        # 30 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible by sp"):
+        sp_forward_prefill(SPEC, params, tokens, jnp.asarray([30]), mesh)
+    wspec = mistral_spec("mistral-tiny", max_seq_len=256).replace(
+        dtype="float32")
+    wparams = init_params(wspec, jax.random.key(0))
+    with pytest.raises(ValueError, match="sliding-window"):
+        sp_forward_prefill(wspec, wparams, jnp.ones((1, 64), jnp.int32),
+                           jnp.asarray([64]), mesh)
+
+
+def test_prefill_fn_selector():
+    assert prefill_fn_for(SPEC, None) is forward_prefill
+    mesh1 = make_mesh(MeshConfig(dp=8), devices=jax.devices()[:8])
+    assert prefill_fn_for(SPEC, mesh1) is forward_prefill   # sp == 1
+    assert prefill_fn_for(SPEC, _mesh()) is not forward_prefill
+
+
+def test_engine_construction_fails_fast_on_bad_sp_config():
+    """Misconfiguration must fail the deploy, not the first request."""
+    mesh = _mesh()
+    wspec = mistral_spec("mistral-tiny", max_seq_len=256).replace(
+        dtype="float32")
+    with pytest.raises(ValueError, match="sliding-window"):
+        Engine(wspec, config=EngineConfig(max_slots=2, max_seq_len=256,
+                                          prefill_buckets=[64]),
+               sp_mesh=mesh)
+    with pytest.raises(ValueError, match="not divisible by sp"):
+        Engine(SPEC, config=EngineConfig(max_slots=2, max_seq_len=256,
+                                         prefill_buckets=[30]),
+               sp_mesh=mesh)
