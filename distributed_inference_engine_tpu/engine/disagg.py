@@ -38,7 +38,6 @@ from ..config import EngineConfig
 from ..models.base import (
     ModelSpec,
     Params,
-    forward_prefill,
     init_params,
     unembed,
 )

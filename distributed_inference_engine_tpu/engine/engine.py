@@ -36,7 +36,6 @@ from ..models.base import (
     ModelSpec,
     Params,
     forward_decode,
-    forward_prefill,
     init_params,
     unembed,
 )

@@ -79,6 +79,34 @@ def engine_from_config(cfg):
 
     spec = spec_for_architecture(arch, size=cfg.metadata.get("size", ""),
                                  max_seq_len=cfg.max_seq_len)
+
+    # parallel-placement metadata: validate BEFORE the (expensive)
+    # checkpoint load/quantize so a bad deploy fails in milliseconds, not
+    # after minutes of safetensors reads on a large model
+    tp = int(cfg.metadata.get("tp", 1))
+    sp = int(cfg.metadata.get("sp", 1))
+    dp = int(cfg.metadata.get("dp", 1))
+    want_mesh = tp > 1 or sp > 1 or dp > 1
+    if want_mesh:
+        import jax as _jax
+
+        if cfg.quantized:
+            raise ValueError(
+                "quantized + mesh metadata (tp/sp/dp) is not supported "
+                "yet — the int8 QuantizedTensor tree has no sharding "
+                "recipe; deploy quantized models unsharded")
+        if dp > 1 and sp <= 1:
+            raise ValueError(
+                "dp metadata only composes with sp (the sequence-parallel "
+                "prefill shards its batch over dp); nothing in the tp-only "
+                "serving path shards over dp — drop dp or deploy replicas "
+                "via the load balancer instead")
+        need = dp * sp * tp
+        devs = _jax.devices()
+        if len(devs) < need:
+            raise ValueError(
+                f"deploy requests mesh dp={dp} sp={sp} tp={tp} "
+                f"({need} devices) but only {len(devs)} are visible")
     from ..utils.checkpoint import is_native_checkpoint, load_params, load_spec
 
     if cfg.path and is_native_checkpoint(cfg.path):
@@ -121,6 +149,28 @@ def engine_from_config(cfg):
               "prefix_cache", "prefill_chunk"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
+
+    # config-driven parallel serving: build the mesh + shardings from the
+    # validated metadata so a plain deploy config (CLI flag, coordinator
+    # deploy_model, config file) can request tensor-/sequence-parallel
+    # placement — no programmatic mesh plumbing needed
+    shard_fn = None
+    kv_sharding = None
+    sp_mesh = None
+    if want_mesh:
+        import jax as _jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import ModelShardings
+        from ..config import MeshConfig
+
+        mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp),
+                         _jax.devices()[: dp * sp * tp])
+        shardings = ModelShardings.build(spec, mesh)
+        shard_fn = shardings.shard_fn()
+        kv_sharding = shardings.paged_kv
+        if sp > 1:
+            sp_mesh = mesh
     spec_k = int(cfg.metadata.get("speculative", 0))
     if spec_k:
         # draft-model speculative decoding (engine/speculative.py):
@@ -139,6 +189,10 @@ def engine_from_config(cfg):
             # a random-weight draft (≈0% acceptance ⇒ slower than plain)
             raise ValueError(
                 f"draft_path {draft_path!r} is not a directory")
+        if shard_fn is not None:
+            raise ValueError(
+                "speculative decoding does not support mesh metadata "
+                "(tp/sp/dp) yet — deploy it unsharded")
         if draft_path:
             d_spec = spec_from_hf_config(draft_path)
             d_spec = d_spec.replace(max_seq_len=min(cfg.max_seq_len,
@@ -154,12 +208,21 @@ def engine_from_config(cfg):
                                  draft_params=d_params, config=ecfg,
                                  speculate_k=spec_k)
     if cfg.metadata.get("role") == "prefill":
-        # disaggregated prefill pool: prefill-only engine (engine/disagg.py)
+        # disaggregated prefill pool: prefill-only engine (engine/disagg.py);
+        # sp here gives the pool sequence-parallel ring-attention prefill
         from ..engine.disagg import PrefillEngine
 
-        return PrefillEngine(spec, params=params, config=ecfg)
+        return PrefillEngine(spec, params=params, config=ecfg,
+                             shard_fn=shard_fn, sp_mesh=sp_mesh)
     if cfg.metadata.get("continuous"):
         from ..engine.continuous import ContinuousEngine
 
-        return ContinuousEngine(spec, params=params, config=ecfg)
-    return Engine(spec, params=params, config=ecfg)
+        if sp_mesh is not None:
+            raise ValueError(
+                "sp metadata is for prefill-phase engines (static, or "
+                "role=prefill); the continuous engine prefills densely — "
+                "use tp (and a disaggregated sp prefill pool) instead")
+        return ContinuousEngine(spec, params=params, config=ecfg,
+                                shard_fn=shard_fn, kv_sharding=kv_sharding)
+    return Engine(spec, params=params, config=ecfg, shard_fn=shard_fn,
+                  sp_mesh=sp_mesh)

@@ -1,0 +1,79 @@
+"""Config-driven parallel serving: ``ModelConfig.metadata`` tp/sp/dp builds
+the mesh + shardings inside ``engine_from_config``, so tensor- and
+sequence-parallel placement deploys through the same CLI / coordinator /
+config-file path as everything else (the reference's registry records
+placement but its engine can't act on it — SURVEY.md §2.3)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import ModelConfig
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models import engine_from_config
+
+
+def _cfg(**meta):
+    return ModelConfig(name="m", architecture="llama-tiny", dtype="float32",
+                       max_batch_size=2, max_seq_len=128, metadata=meta)
+
+
+def test_tp_metadata_builds_sharded_continuous_engine():
+    eng = engine_from_config(_cfg(continuous=1, page_size=16, tp=4))
+    wq = eng.params["blocks"]["wq"]
+    assert "tp" in str(wq.sharding.spec)
+    # page pools sharded too (per-chip KV HBM drops with tp)
+    assert "tp" in str(eng.kv.k_pages.sharding.spec)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3, 4],
+                                          max_new_tokens=6)])[0]
+    assert len(out.tokens) == 6
+    # parity with an unsharded engine on the same params is covered by
+    # tests/test_parallel.py; here the contract is the CONFIG path works
+
+
+def test_sp_metadata_builds_sp_prefill_static_engine():
+    plain = engine_from_config(_cfg(prefill_buckets=[64]))
+    sp = engine_from_config(_cfg(sp=4, dp=2, prefill_buckets=[64]))
+    # same seed => same random init => token-identical greedy output
+    req = lambda: GenerationRequest(prompt=list(range(1, 50)),
+                                    max_new_tokens=8)
+    assert plain.generate([req()])[0].tokens == sp.generate([req()])[0].tokens
+
+
+def test_sp_prefill_pool_from_config():
+    eng = engine_from_config(_cfg(role="prefill", sp=4,
+                                  prefill_buckets=[64]))
+    h = eng.prefill([GenerationRequest(prompt=list(range(1, 40)),
+                                       max_new_tokens=4,
+                                       request_id="r1")])[0]
+    assert h.prompt_len == 39 and h.k.shape[1] == 39
+
+
+def test_continuous_plus_sp_rejected():
+    with pytest.raises(ValueError, match="prefill-phase"):
+        engine_from_config(_cfg(continuous=1, sp=4))
+
+
+def test_quantized_plus_mesh_rejected():
+    cfg = _cfg(tp=4)
+    cfg.quantized = True
+    with pytest.raises(ValueError, match="quantized"):
+        engine_from_config(cfg)
+
+
+def test_speculative_plus_mesh_rejected():
+    with pytest.raises(ValueError, match="unsharded"):
+        engine_from_config(_cfg(tp=4, speculative=2,
+                                draft_size="llama-tiny"))
+
+
+def test_too_many_devices_requested():
+    with pytest.raises(ValueError, match="devices"):
+        engine_from_config(_cfg(tp=64))
+
+
+def test_dp_without_sp_rejected():
+    """dp shards nothing in the tp-only serving path — accepting it would
+    silently waste half the slice."""
+    with pytest.raises(ValueError, match="load balancer"):
+        engine_from_config(_cfg(continuous=1, dp=2, tp=4))
