@@ -285,6 +285,12 @@ class WorkerServer(FramedServerMixin):
             return
         t0 = time.perf_counter()
         engine = self.engine_factory(cfg)
+        if cfg.metadata.get("warmup") and hasattr(engine, "warmup"):
+            # pre-compile the serving programs at load time so the first
+            # real request doesn't pay the XLA compile (metadata warmup=1)
+            n = engine.warmup()
+            logger.info("worker %s warmed %s (%d rounds)",
+                        self.worker_id, cfg.name, n)
         self.engines[cfg.name] = engine
         self.model_configs[cfg.name] = cfg
         # continuous engines get a rolling-batch pump (serving/pump.py)

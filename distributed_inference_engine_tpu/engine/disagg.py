@@ -171,6 +171,24 @@ class PrefillEngine:
         self._total_prompt_tokens = 0
         self._total_handoff_bytes = 0
 
+    def warmup(self, batch: Optional[int] = None) -> int:
+        """Pre-compile one prefill program per (batch bucket × prefill
+        bucket) (see ``Engine.warmup``). Returns the number of warmup
+        prefills run."""
+        sizes = [batch] if batch else self.batch_buckets
+        runs = 0
+        for n in sizes:
+            for tb in self.prefill_buckets:
+                prompt_len = min(tb, self.max_seq_len - 1)
+                self.prefill([
+                    GenerationRequest(prompt=[1] * prompt_len,
+                                      max_new_tokens=1,
+                                      request_id=f"warmup-{n}-{tb}-{i}")
+                    for i in range(n)
+                ])
+                runs += 1
+        return runs
+
     def prefill(self, requests: List[GenerationRequest]) -> List[PrefillHandoff]:
         """Run one bucketed prefill batch; one handoff per request."""
         if not requests:

@@ -310,6 +310,33 @@ class Engine:
             )
         return results
 
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self, batch: Optional[int] = None,
+               max_new_tokens: int = 2) -> int:
+        """Pre-compile the serving programs by running one tiny generate
+        per (batch bucket × prefill bucket) — EVERY batch bucket by
+        default, because the first real request is typically a single one
+        (bb=1) and warming only the largest bucket would leave exactly
+        that shape cold. Because total-cap buckets round up, a warmup with
+        small ``max_new_tokens`` usually lands in the same decode-chunk
+        shape moderate generations use; the prompt is clamped below the
+        top sequence bucket so at least one decode chunk actually runs.
+        Stat counters do tick (warmup IS traffic). Returns the number of
+        warmup generates run."""
+        sizes = [batch] if batch else self.batch_buckets
+        runs = 0
+        for n in sizes:
+            for tb in self.prefill_buckets:
+                plen = max(1, min(tb, self.seq_buckets[-1] - max_new_tokens))
+                self.generate([
+                    GenerationRequest(prompt=[1] * plen,
+                                      max_new_tokens=max_new_tokens)
+                    for _ in range(n)
+                ])
+                runs += 1
+        return runs
+
     # ------------------------------------------------------------- metrics
 
     def get_metrics(self) -> Dict[str, Any]:

@@ -362,6 +362,28 @@ class SpeculativeEngine:
             ))
         return results
 
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self, batch: Optional[int] = None,
+               max_new_tokens: int = 2) -> int:
+        """Pre-compile prefill + speculative rounds per (batch bucket ×
+        prefill bucket); the prompt is clamped so at least one speculative
+        round actually runs (see ``Engine.warmup``). Returns the number of
+        warmup generates run."""
+        sizes = [batch] if batch else self.batch_buckets
+        cap = self.seq_buckets[-1] - self.k - 1 - max_new_tokens
+        runs = 0
+        for n in sizes:
+            for tb in self.prefill_buckets:
+                plen = max(1, min(tb, cap))
+                self.generate([
+                    GenerationRequest(prompt=[1] * plen,
+                                      max_new_tokens=max_new_tokens)
+                    for _ in range(n)
+                ])
+                runs += 1
+        return runs
+
     # ------------------------------------------------------------ metrics
 
     def get_metrics(self) -> Dict[str, Any]:

@@ -95,6 +95,10 @@ def engine_from_config(cfg):
                 "quantized + mesh metadata (tp/sp/dp) is not supported "
                 "yet — the int8 QuantizedTensor tree has no sharding "
                 "recipe; deploy quantized models unsharded")
+        if int(cfg.metadata.get("speculative", 0)):
+            raise ValueError(
+                "speculative decoding does not support mesh metadata "
+                "(tp/sp/dp) yet — deploy it unsharded")
         if dp > 1 and sp <= 1:
             raise ValueError(
                 "dp metadata only composes with sp (the sequence-parallel "
@@ -189,10 +193,6 @@ def engine_from_config(cfg):
             # a random-weight draft (≈0% acceptance ⇒ slower than plain)
             raise ValueError(
                 f"draft_path {draft_path!r} is not a directory")
-        if shard_fn is not None:
-            raise ValueError(
-                "speculative decoding does not support mesh metadata "
-                "(tp/sp/dp) yet — deploy it unsharded")
         if draft_path:
             d_spec = spec_from_hf_config(draft_path)
             d_spec = d_spec.replace(max_seq_len=min(cfg.max_seq_len,
