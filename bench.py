@@ -77,13 +77,12 @@ def main() -> None:
 
     spec = gpt2_spec(MODEL)
     # BENCH_ENGINE=continuous measures the serving engine (paged KV,
-    # batched admission) instead of the static batch engine. One device
-    # dispatch per chunk default for static (over a tunnelled/remote
-    # device the fixed per-launch latency dominates); the continuous
-    # engine interleaves admissions, so it keeps shorter chunks.
+    # batched admission) instead of the static batch engine.
     engine_kind = os.environ.get("BENCH_ENGINE", "static")
-    steps = int(os.environ.get(
-        "BENCH_STEPS", str(NEW_TOKENS if engine_kind == "static" else 64)))
+    # continuous default matches the static chunk: this benchmark submits
+    # every request up front, so shorter chunks only add sync round trips
+    # (serving deployments pick shorter chunks for admission latency)
+    steps = int(os.environ.get("BENCH_STEPS", str(NEW_TOKENS)))
     cfg = EngineConfig(
         max_slots=BATCH,
         max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),

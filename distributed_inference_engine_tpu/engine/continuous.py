@@ -887,33 +887,40 @@ class ContinuousEngine:
 
     # ------------------------------------------------------------- warmup
 
-    def warmup(self, max_new_tokens: int = 2) -> int:
+    def warmup(self, batch: Optional[int] = None,
+               max_new_tokens: int = 2) -> int:
         """Pre-compile the serving programs: one rolling batch per
         (admission batch bucket × prefill bucket) — admission prefills pad
         to power-of-two batch buckets, so every occupancy a real burst can
-        produce gets its program. Warmup prompts DIFFER per slot (a shared
-        prompt would collapse into one admission plus prefix-cache hits
-        and leave the batched-admission programs cold). The paged pools
-        are fixed-shape, so the decode chunk compiles once; pages and
-        slots are fully returned afterwards. Stat counters do tick.
-        Returns the number of warmup rounds."""
+        produce gets its program (``batch`` restricts to one bucket, same
+        contract as the sibling engines). Warmup prompts DIFFER across the
+        ENTIRE warmup (a repeated prompt — even from an earlier round —
+        would hit the prefix cache and take the cached-suffix path,
+        leaving the batched-admission programs cold). The paged pools are
+        fixed-shape, so the decode chunk compiles once; pages and slots
+        are fully returned afterwards. Stat counters do tick. Returns the
+        number of warmup rounds."""
         runs = 0
         v = self.spec.vocab_size
-        bb = 1
-        sizes = []
-        while bb < self.max_slots:
-            sizes.append(bb)
-            bb *= 2
-        sizes.append(self.max_slots)
+        if batch:
+            sizes = [batch]
+        else:
+            bb = 1
+            sizes = []
+            while bb < self.max_slots:
+                sizes.append(bb)
+                bb *= 2
+            sizes.append(self.max_slots)
+        lead = 0
         for n in sizes:
             for tb in self.prefill_buckets:
                 prompt_len = min(tb, self.max_seq_len - 1 - max_new_tokens)
                 if prompt_len < 1:
                     continue
-                for i in range(n):
-                    lead = (i % (v - 1)) + 1     # distinct first token/page
+                for _ in range(n):
+                    lead += 1                    # unique across ALL rounds
                     self.submit(GenerationRequest(
-                        prompt=[lead] * prompt_len,
+                        prompt=[(lead % (v - 1)) + 1] * prompt_len,
                         max_new_tokens=max_new_tokens))
                 self.run_until_idle()
                 runs += 1

@@ -32,6 +32,11 @@ def test_continuous_warmup_returns_all_pages():
     rounds = eng.warmup()
     # (admission buckets {1,2}) x (prefill buckets {16,64,128}) = 6 rounds
     assert rounds == 6
+    m = eng.get_metrics()
+    # every round ran ONE batched admission: a repeated warmup prompt
+    # would hit the prefix cache and leave the batched programs cold
+    assert m["prefill_calls"] == rounds
+    assert m["prefix_hit_admissions"] == 0
     stats = eng.kv.get_stats()
     assert stats["live_slots"] == 0
     assert eng.n_live == 0 and eng.n_waiting == 0
