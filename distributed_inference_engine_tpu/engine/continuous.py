@@ -893,15 +893,15 @@ class ContinuousEngine:
         (admission batch bucket × prefill bucket) — admission prefills pad
         to power-of-two batch buckets, so every occupancy a real burst can
         produce gets its program (``batch`` restricts to one bucket, same
-        contract as the sibling engines). Warmup prompts DIFFER across the
-        ENTIRE warmup (a repeated prompt — even from an earlier round —
-        would hit the prefix cache and take the cached-suffix path,
-        leaving the batched-admission programs cold). The paged pools are
-        fixed-shape, so the decode chunk compiles once; pages and slots
-        are fully returned afterwards. Stat counters do tick. Returns the
-        number of warmup rounds."""
+        contract as the sibling engines). The prefix cache is DISABLED for
+        the duration (and nothing registers): warmup prompts would
+        otherwise alias each other — across rounds, and unavoidably on
+        small vocabularies — collapsing batched admissions into
+        cached-suffix hits and leaving those programs cold. The paged
+        pools are fixed-shape, so the decode chunk compiles once; pages
+        and slots are fully returned afterwards. Stat counters do tick.
+        Returns the number of warmup rounds."""
         runs = 0
-        v = self.spec.vocab_size
         if batch:
             sizes = [batch]
         else:
@@ -911,19 +911,23 @@ class ContinuousEngine:
                 sizes.append(bb)
                 bb *= 2
             sizes.append(self.max_slots)
-        lead = 0
-        for n in sizes:
-            for tb in self.prefill_buckets:
-                prompt_len = min(tb, self.max_seq_len - 1 - max_new_tokens)
-                if prompt_len < 1:
-                    continue
-                for _ in range(n):
-                    lead += 1                    # unique across ALL rounds
-                    self.submit(GenerationRequest(
-                        prompt=[(lead % (v - 1)) + 1] * prompt_len,
-                        max_new_tokens=max_new_tokens))
-                self.run_until_idle()
-                runs += 1
+        saved_prefix = self.prefix_cache
+        self.prefix_cache = False
+        try:
+            for n in sizes:
+                for tb in self.prefill_buckets:
+                    prompt_len = min(tb,
+                                     self.max_seq_len - 1 - max_new_tokens)
+                    if prompt_len < 1:
+                        continue
+                    for _ in range(n):
+                        self.submit(GenerationRequest(
+                            prompt=[1] * prompt_len,
+                            max_new_tokens=max_new_tokens))
+                    self.run_until_idle()
+                    runs += 1
+        finally:
+            self.prefix_cache = saved_prefix
         return runs
 
     # ------------------------------------------------------------ metrics

@@ -25,6 +25,17 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30   # large-but-finite: -inf rows would softmax to NaN
 
+_FP8 = ("float8_e4m3fn", "float8_e5m2")
+
+
+def _upcast_fp8(k: jnp.ndarray, v: jnp.ndarray, dt) -> tuple:
+    """fp8 KV caches (half the KV HBM of bf16) have no implicit promotion
+    path — upcast to the query dtype at the attention boundary. Wider
+    caches (fp32 kv under bf16 compute) keep their implicit promotion."""
+    if k.dtype.name in _FP8:
+        return k.astype(dt), v.astype(dt)
+    return k, v
+
 
 def _group_query(q: jnp.ndarray, n_kv_heads: int) -> jnp.ndarray:
     """[B, T, H, Dh] -> [B, T, Hkv, G, Dh] where H = Hkv * G."""
@@ -45,6 +56,7 @@ def causal_attention(
     """
     b, t, h, dh = q.shape
     n_kv = k.shape[2]
+    k, v = _upcast_fp8(k, v, q.dtype)
     qg = _group_query(q, n_kv)                                   # [B,T,Hkv,G,Dh]
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     # scores: [B, Hkv, G, T, T]
@@ -80,6 +92,8 @@ def suffix_attention(
     b, ts, h, dh = q.shape
     tc = k_ctx.shape[1]
     n_kv = k_ctx.shape[2]
+    k_ctx, v_ctx = _upcast_fp8(k_ctx, v_ctx, q.dtype)
+    k_suf, v_suf = _upcast_fp8(k_suf, v_suf, q.dtype)
     qg = _group_query(q, n_kv)                                   # [B,Ts,Hkv,G,Dh]
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     k_all = jnp.concatenate([k_ctx, k_suf], axis=1)              # [B,Tc+Ts,...]
@@ -120,6 +134,7 @@ def cached_attention(
     b, t, h, dh = q.shape
     s = cache_k.shape[1]
     n_kv = cache_k.shape[2]
+    cache_k, cache_v = _upcast_fp8(cache_k, cache_v, q.dtype)
     qg = _group_query(q, n_kv)                                   # [B,1,Hkv,G,Dh]
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     scores = jnp.einsum("bikgd,bjkd->bkgij", qg, cache_k).astype(jnp.float32) * scale

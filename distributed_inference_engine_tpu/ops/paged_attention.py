@@ -49,6 +49,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import _upcast_fp8
+
 NEG_INF = -1e30
 
 
@@ -73,8 +75,10 @@ def paged_attention_xla(
     mp = page_table.shape[1]
     g = h // n_kv_heads
 
-    k = k_pages[page_table]                       # [B, MP, P, Hkv*Dh]
-    v = v_pages[page_table]
+    # gather FIRST, upcast the gathered pages only: upcasting the whole
+    # pool would materialize a full wide copy per decode call — the HBM
+    # traffic the fp8 cache exists to avoid
+    k, v = _upcast_fp8(k_pages[page_table], v_pages[page_table], q.dtype)
     k = k.reshape(b, mp * p, n_kv_heads, dh)      # [B, S, Hkv, Dh]
     v = v.reshape(b, mp * p, n_kv_heads, dh)
 

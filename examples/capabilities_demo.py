@@ -172,6 +172,12 @@ def demo_warmup() -> None:
 
 
 def main() -> None:
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        sys.exit(
+            "this demo needs the virtual 8-device CPU mesh — run as:\n"
+            "  JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "python examples/capabilities_demo.py")
     print(f"devices: {len(jax.devices())} x {jax.devices()[0].platform}")
     demo_families()
     demo_stops_minp()
