@@ -75,6 +75,7 @@ def engine_from_config(cfg):
 
     from ..config import EngineConfig
     from ..engine.engine import Engine
+    from .base import init_params
     from .loader import load_checkpoint, spec_from_hf_config
 
     spec = spec_for_architecture(arch, size=cfg.metadata.get("size", ""),
@@ -113,6 +114,18 @@ def engine_from_config(cfg):
                 f"({need} devices) but only {len(devs)} are visible")
     from ..utils.checkpoint import is_native_checkpoint, load_params, load_spec
 
+    built = None                       # (mesh, ModelShardings) once built
+
+    def _build_shardings(final_spec):
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import ModelShardings
+        from ..config import MeshConfig
+        import jax as _jax
+
+        mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp),
+                         _jax.devices()[: dp * sp * tp])
+        return mesh, ModelShardings.build(final_spec, mesh)
+
     if cfg.path and is_native_checkpoint(cfg.path):
         # our own Orbax checkpoint dir (utils/checkpoint.py): spec sidecar
         # + params tree, no HF mapping needed; the sidecar's dtype is
@@ -120,7 +133,22 @@ def engine_from_config(cfg):
         ck_spec = load_spec(cfg.path)
         spec = ck_spec.replace(max_seq_len=min(cfg.max_seq_len,
                                                ck_spec.max_seq_len))
-        params = load_params(cfg.path)
+        if want_mesh:
+            # restore DIRECTLY into the mesh layout: loading the full tree
+            # onto one device and resharding after would peak at the whole
+            # model's bytes on a single chip
+            import jax as _jax
+
+            built = _build_shardings(spec)      # reused by the engine below
+            abstract = _jax.eval_shape(
+                lambda: init_params(spec, _jax.random.key(0)))
+            template = _jax.tree.map(
+                lambda a, sh: _jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                    sharding=sh),
+                abstract, built[1].params)
+            params = load_params(cfg.path, template=template)
+        else:
+            params = load_params(cfg.path)
     elif cfg.path and os.path.isdir(cfg.path):
         hf_spec = spec_from_hf_config(cfg.path)
         spec = hf_spec.replace(max_seq_len=min(cfg.max_seq_len,
@@ -162,15 +190,9 @@ def engine_from_config(cfg):
     kv_sharding = None
     sp_mesh = None
     if want_mesh:
-        import jax as _jax
-
-        from ..parallel.mesh import make_mesh
-        from ..parallel.sharding import ModelShardings
-        from ..config import MeshConfig
-
-        mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp),
-                         _jax.devices()[: dp * sp * tp])
-        shardings = ModelShardings.build(spec, mesh)
+        if built is None:
+            built = _build_shardings(spec)
+        mesh, shardings = built
         shard_fn = shardings.shard_fn()
         kv_sharding = shardings.paged_kv
         if sp > 1:

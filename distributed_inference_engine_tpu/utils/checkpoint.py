@@ -107,11 +107,22 @@ def load_params(path: str, template: Optional[Any] = None) -> Any:
     ckptr = ocp.PyTreeCheckpointer()
     try:
         if template is not None:
-            return _decode_tree(ckptr.restore(p,
-                                              item=_encode_tree(template)))
+            return _decode_tree(_restore_with_template(ckptr, p, template))
         return _decode_tree(ckptr.restore(p))
     finally:
         ckptr.close()
+
+
+def _restore_with_template(ckptr, p, template):
+    """Restore honoring the template's shardings: ``item=`` alone does NOT
+    set restore shardings (Orbax materialises every leaf on one device and
+    warns 'Sharding info not provided') — explicit restore_args built from
+    the template leaves are what place shards directly on the mesh."""
+    import orbax.checkpoint as ocp
+
+    enc = _encode_tree(template)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(enc)
+    return ckptr.restore(p, item=enc, restore_args=restore_args)
 
 
 def save_train_state(path: str, spec, state: Dict[str, Any]) -> str:
@@ -135,8 +146,7 @@ def load_train_state(path: str, template: Optional[Any] = None) -> Any:
     ckptr = ocp.PyTreeCheckpointer()
     try:
         if template is not None:
-            return _decode_tree(ckptr.restore(p,
-                                              item=_encode_tree(template)))
+            return _decode_tree(_restore_with_template(ckptr, p, template))
         return _decode_tree(ckptr.restore(p))
     finally:
         ckptr.close()
