@@ -101,6 +101,7 @@ def result_to_dict(r: GenerationResult) -> Dict[str, Any]:
         "tokens": list(r.tokens),
         "finish_reason": r.finish_reason,
         "prompt_tokens": r.prompt_tokens,
+        "logprobs": [float(x) for x in r.logprobs],
         "ttft_s": r.ttft_s,
         "decode_s": r.decode_s,
         "metadata": dict(r.metadata),
@@ -113,6 +114,7 @@ def result_from_dict(d: Dict[str, Any]) -> GenerationResult:
         tokens=list(d.get("tokens", [])),
         finish_reason=str(d.get("finish_reason", "")),
         prompt_tokens=int(d.get("prompt_tokens", 0)),
+        logprobs=[float(x) for x in d.get("logprobs", [])],
         ttft_s=float(d.get("ttft_s", 0.0)),
         decode_s=float(d.get("decode_s", 0.0)),
         metadata=dict(d.get("metadata", {})),

@@ -49,7 +49,11 @@ from ..models.base import (
     unembed,
     write_prefill_pages,
 )
-from ..ops.sampling import SamplingParams, sample_tokens
+from ..ops.sampling import (
+    SamplingParams,
+    sample_tokens,
+    sample_tokens_with_logprobs,
+)
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache
@@ -67,8 +71,8 @@ class _Slot:
     """Host-side bookkeeping for one live sequence."""
 
     __slots__ = ("request", "slot_id", "prompt_len", "produced", "tokens",
-                 "admitted_at", "first_token_at", "on_tokens", "streamed",
-                 "stop_cut")
+                 "logprobs", "admitted_at", "first_token_at", "on_tokens",
+                 "streamed", "stop_cut")
 
     def __init__(self, request: GenerationRequest, slot_id: int,
                  prompt_len: int, on_tokens=None) -> None:
@@ -77,6 +81,7 @@ class _Slot:
         self.prompt_len = prompt_len
         self.produced = 0
         self.tokens: List[int] = []
+        self.logprobs: List[float] = []
         self.admitted_at = time.perf_counter()
         self.first_token_at = 0.0
         self.on_tokens = on_tokens      # streaming: cb(new_tokens: List[int])
@@ -203,8 +208,12 @@ class ContinuousEngine:
             last = hidden[jnp.arange(tokens.shape[0]), seq_lens - 1]
             logits = unembed(spec_, params, last)
             # sampled in-program: eager sampling is a dispatch chain that
-            # wrecks TTFT on remote/tunnelled devices
-            return sample_tokens(logits, sampling, key), ks, vs
+            # wrecks TTFT on remote/tunnelled devices. Token + logprob
+            # pack into one [2, B] int32 buffer (one blocking read).
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
+            packed = jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
+            return packed, ks, vs
 
         page_size = self.kv.page_size
 
@@ -227,7 +236,9 @@ class ContinuousEngine:
             )
             last = hidden[jnp.arange(tokens.shape[0]), suffix_lens - 1]
             logits = unembed(spec_, params, last)
-            return sample_tokens(logits, sampling, key), ks, vs
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
+            return jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)]), ks, vs
 
         fwd = partial(forward_decode_paged, attn_impl=self.attn_impl)
 
@@ -243,7 +254,8 @@ class ContinuousEngine:
                     spec_, params, last, lengths, kp, vp, page_table, active
                 )
                 logits = unembed(spec_, params, hidden)
-                next_tok = sample_tokens(logits, sampling, step_key)
+                next_tok, lp = sample_tokens_with_logprobs(
+                    logits, sampling, step_key)
                 was_active = active
                 produced = produced + was_active.astype(jnp.int32)
                 hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
@@ -252,17 +264,19 @@ class ContinuousEngine:
                 active = was_active & ~done
                 last = jnp.where(was_active, next_tok, last)
                 emitted = jnp.where(was_active, next_tok, -1)
-                return (kp, vp, new_len, last, active, produced), emitted
+                lp = jnp.where(was_active, lp, 0.0)
+                return (kp, vp, new_len, last, active, produced), (emitted, lp)
 
             keys = jax.random.split(key, n_steps)
-            carry, toks = jax.lax.scan(
+            carry, (toks, lps) = jax.lax.scan(
                 step, (kp, vp, lengths, last_tokens, active, produced), keys
             )
-            # pack tokens + active flags + lengths into ONE output buffer:
-            # the host makes exactly one blocking read per chunk (each sync
-            # is a full round trip on remote devices)
+            # pack tokens + logprobs (bitcast) + active flags + lengths into
+            # ONE output buffer: the host makes exactly one blocking read
+            # per chunk (each sync is a full round trip on remote devices)
             packed = jnp.concatenate(
-                [toks, carry[4][None].astype(jnp.int32), carry[2][None]],
+                [toks, jax.lax.bitcast_convert_type(lps, jnp.int32),
+                 carry[4][None].astype(jnp.int32), carry[2][None]],
                 axis=0)
             return carry, packed
 
@@ -396,16 +410,19 @@ class ContinuousEngine:
             self.kv.swap(kp, vp)
             self._total_prompt_tokens += prompt_len
             self._install_slot(req, slot, prompt_len, handoff.first_token,
-                               t0, on_tok, t_submit=t_submit)
+                               t0, on_tok, t_submit=t_submit,
+                               first_lp=getattr(handoff, "first_logprob",
+                                                0.0))
         return admitted
 
     def _register_slot_host(self, req: GenerationRequest, slot: int,
                             prompt_len: int, first: int, t_submit: float,
-                            on_tokens=None) -> bool:
+                            on_tokens=None, first_lp: float = 0.0) -> bool:
         """Host bookkeeping of one admission; returns True when the slot
         stays live (i.e. needs its device state installed)."""
         state = _Slot(req, slot, prompt_len, on_tokens)
         state.tokens.append(first)
+        state.logprobs.append(first_lp)
         state.produced = 1
         # the TTFT clock starts at SUBMIT: queue wait while slots/pages
         # were busy is exactly the latency a loaded engine must report
@@ -460,14 +477,15 @@ class ContinuousEngine:
 
     def _install_slot(self, req: GenerationRequest, slot: int,
                       prompt_len: int, first: int, t_dispatch: float,
-                      on_tokens, t_submit: float) -> None:
+                      on_tokens, t_submit: float,
+                      first_lp: float = 0.0) -> None:
         """Single-admission tail (suffix / disaggregated paths); batched
         admissions go through ``_admit_batch``. ``t_dispatch`` feeds the
         prefill-latency histogram; ``t_submit`` starts the request's
         TTFT clock (queue wait included)."""
         self.prefill_stats.add(time.perf_counter() - t_dispatch)
         if self._register_slot_host(req, slot, prompt_len, first,
-                                    t_submit, on_tokens):
+                                    t_submit, on_tokens, first_lp=first_lp):
             self._install_device(
                 [self._slot_row(req, slot, prompt_len, first)])
 
@@ -541,10 +559,13 @@ class ContinuousEngine:
                 first_dev = self._prefill_cached_suffix(
                     prompt, slot, n_cached, sampling, k0)
                 self.kv.register_prefix(slot, prompt)
-                first = int(np.asarray(first_dev)[0])
+                fp = np.asarray(first_dev)           # [2, 1]: token; lp bits
+                first = int(fp[0, 0])
+                first_lp = float(fp[1].view(np.float32)[0])
                 self._total_prompt_tokens += len(prompt)
                 self._install_slot(req, slot, len(prompt), first, t0,
-                                   on_tok, t_submit=t_submit)
+                                   on_tok, t_submit=t_submit,
+                                   first_lp=first_lp)
             else:
                 batch.append((req, on_tok, slot, prompt, t_submit))
                 if len(batch) >= self.max_slots:
@@ -596,7 +617,9 @@ class ContinuousEngine:
             jnp.asarray(table_rows), seq_dev,
         )
         self.kv.swap(kp, vp)
-        firsts = np.asarray(first_dev)
+        fp = np.asarray(first_dev)                 # [2, bb]: tokens; lp bits
+        firsts = fp[0]
+        first_lps = fp[1].view(np.float32)
         self.prefill_stats.add(time.perf_counter() - t0)   # once per dispatch
         rows: List[Dict[str, Any]] = []
         for i, (req, cb, slot, prompt, t_submit) in enumerate(batch):
@@ -605,7 +628,8 @@ class ContinuousEngine:
             self._total_prompt_tokens += len(prompt)
             first = int(firsts[i])
             if self._register_slot_host(req, slot, len(prompt), first,
-                                        t_submit, cb):
+                                        t_submit, cb,
+                                        first_lp=float(first_lps[i])):
                 rows.append(self._slot_row(req, slot, len(prompt), first))
         self._install_device(rows)
 
@@ -703,9 +727,12 @@ class ContinuousEngine:
             # only the LAST chunk's sample is the real first token (earlier
             # chunks' samples are discarded — their logits see a truncated
             # prompt)
-            first = int(np.asarray(first_dev)[0])
+            fp = np.asarray(first_dev)               # [2, 1]: token; lp bits
+            first = int(fp[0, 0])
+            first_lp = float(fp[1].view(np.float32)[0])
             if self._register_slot_host(req, slot, len(prog.prompt), first,
-                                        prog.t_submit, prog.on_tokens):
+                                        prog.t_submit, prog.on_tokens,
+                                        first_lp=first_lp):
                 self._install_device(
                     [self._slot_row(req, slot, len(prog.prompt), first)])
 
@@ -750,6 +777,7 @@ class ContinuousEngine:
             tokens=toks,
             finish_reason=reason,
             prompt_tokens=state.prompt_len,
+            logprobs=state.logprobs[: len(toks)],
             ttft_s=state.first_token_at - state.admitted_at,
             decode_s=time.perf_counter() - state.first_token_at,
         ))
@@ -803,15 +831,20 @@ class ContinuousEngine:
         self.kv.swap(kp, vp)
 
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
-        toks_np = packed_np[:-2]                         # [n_steps, max_slots]
+        toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
+        lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)
         active_np = packed_np[-2].astype(bool)
         self._lengths_host = packed_np[-1].astype(np.int32)
         self.chunk_stats.add(time.perf_counter() - t0)
 
         for slot, state in list(self._slots.items()):
             col = toks_np[:, slot]
+            lcol = lps_np[:, slot]
             prev = len(state.tokens)           # first index not yet stop-checked
-            state.tokens.extend(int(t) for t in col if t >= 0)
+            for si in range(col.shape[0]):
+                if col[si] >= 0:
+                    state.tokens.append(int(col[si]))
+                    state.logprobs.append(float(lcol[si]))
             state.produced = len(state.tokens)
             req = state.request
             has_stops = (req.eos_id >= 0 or req.stop_ids

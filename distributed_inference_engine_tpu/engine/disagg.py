@@ -41,7 +41,10 @@ from ..models.base import (
     init_params,
     unembed,
 )
-from ..ops.sampling import SamplingParams, sample_tokens
+from ..ops.sampling import (
+    SamplingParams,
+    sample_tokens_with_logprobs,
+)
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .types import GenerationRequest
@@ -62,6 +65,7 @@ class PrefillHandoff:
     first_token: int
     k: np.ndarray
     v: np.ndarray
+    first_logprob: float = 0.0       # untempered log p of first_token
 
     def nbytes(self) -> int:
         return self.k.nbytes + self.v.nbytes
@@ -73,6 +77,7 @@ def handoff_to_wire(h: PrefillHandoff) -> Dict[str, Any]:
         "request_id": h.request_id,
         "prompt_len": h.prompt_len,
         "first_token": h.first_token,
+        "first_logprob": h.first_logprob,
         "dtype": jnp.dtype(h.k.dtype).name,
         "shape": list(h.k.shape),
         "k": h.k.tobytes(),
@@ -95,6 +100,7 @@ def handoff_from_wire(d: Dict[str, Any]) -> PrefillHandoff:
         request_id=str(d["request_id"]),
         prompt_len=int(d["prompt_len"]),
         first_token=int(d["first_token"]),
+        first_logprob=float(d.get("first_logprob", 0.0)),
         k=_arr(d["k"]),
         v=_arr(d["v"]),
     )
@@ -156,9 +162,12 @@ class PrefillEngine:
             b = tokens.shape[0]
             last = hidden[jnp.arange(b), seq_lens - 1]
             logits = unembed(spec_, params, last)
-            # first token sampled in-program (eager sampling costs a chain
-            # of device dispatches — ruinous on remote/tunnelled devices)
-            first = sample_tokens(logits, sampling, key)
+            # first token + its logprob sampled in-program (eager sampling
+            # costs a chain of device dispatches — ruinous on
+            # remote/tunnelled devices), packed into one [2, B] buffer
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
+            first = jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
             # [L, B, T, Hkv, Dh] -> [B, L, T, Hkv, Dh] so per-request slices
             # on the host are contiguous reads
             ks = jnp.swapaxes(ks, 0, 1).astype(self.kv_dtype)
@@ -231,7 +240,9 @@ class PrefillEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
             sampling, k0,
         )
-        first = np.asarray(first_dev)
+        fp = np.asarray(first_dev)                 # [2, bb]: tokens; lp bits
+        first = fp[0]
+        first_lps = fp[1].view(np.float32)
         ks_np = np.asarray(jax.device_get(ks))     # [bb, L, tb, Hkv, Dh]
         vs_np = np.asarray(jax.device_get(vs))
         self.prefill_stats.add(time.perf_counter() - t0)
@@ -245,6 +256,7 @@ class PrefillEngine:
                 request_id=r.request_id or f"prefill-{self._total_requests}-{i}",
                 prompt_len=t,
                 first_token=int(first[i]),
+                first_logprob=float(first_lps[i]),
                 k=ks_np[i, :, :t].copy(),                     # [L, T, Hkv, Dh]
                 v=vs_np[i, :, :t].copy(),
             )

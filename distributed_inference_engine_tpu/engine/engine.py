@@ -39,7 +39,11 @@ from ..models.base import (
     init_params,
     unembed,
 )
-from ..ops.sampling import SamplingParams, sample_tokens
+from ..ops.sampling import (
+    SamplingParams,
+    sample_tokens,
+    sample_tokens_with_logprobs,
+)
 from ..utils.tracing import LatencyStats
 from .types import (  # noqa: F401  (re-export)
     GenerationRequest,
@@ -131,9 +135,12 @@ class Engine:
             logits = unembed(spec_, params, last)             # [B, V] fp32
             # sample INSIDE the program: an eager sample after prefill is
             # a chain of separate device dispatches — ruinous TTFT on a
-            # remote/tunnelled device
-            first = sample_tokens(logits, sampling, key)
-            return first, ks, vs
+            # remote/tunnelled device. Token + its logprob pack into one
+            # [2, B] int32 buffer (logprob bitcast) = one blocking read.
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
+            packed = jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
+            return packed, ks, vs
 
         @partial(jax.jit, static_argnames=("n_steps",), donate_argnums=(1, 2, 3, 4, 5, 6))
         def _decode_chunk(
@@ -152,7 +159,8 @@ class Engine:
                     spec_, params, last, lengths, ck, cv
                 )
                 logits = unembed(spec_, params, hidden)        # [B, V]
-                next_tok = sample_tokens(logits, sampling, step_key)
+                next_tok, lp = sample_tokens_with_logprobs(
+                    logits, sampling, step_key)
                 was_active = active
                 produced = produced + was_active.astype(jnp.int32)
                 hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
@@ -161,19 +169,20 @@ class Engine:
                 lengths = lengths + was_active.astype(jnp.int32)
                 last = jnp.where(was_active, next_tok, last)
                 emitted = jnp.where(was_active, next_tok, -1)
-                return (ck, cv, lengths, last, active, produced), emitted
+                lp = jnp.where(was_active, lp, 0.0)
+                return (ck, cv, lengths, last, active, produced), (emitted, lp)
 
             keys = jax.random.split(key, n_steps)
-            carry, toks = jax.lax.scan(
+            carry, (toks, lps) = jax.lax.scan(
                 step, (ck, cv, lengths, last_tokens, active, produced), keys
             )
-            # pack emitted tokens + live flags into ONE buffer: the host
-            # then makes exactly one blocking read per chunk. Each sync is
-            # a full round trip — ~100 ms on a tunnelled/remote device —
-            # so a separate active.any() readback would double the
-            # per-chunk overhead.
+            # pack emitted tokens + their logprobs (bitcast) + live flags
+            # into ONE buffer: the host then makes exactly one blocking
+            # read per chunk. Each sync is a full round trip — ~100 ms on
+            # a tunnelled/remote device.
             packed = jnp.concatenate(
-                [toks, carry[4][None].astype(jnp.int32)], axis=0)
+                [toks, jax.lax.bitcast_convert_type(lps, jnp.int32),
+                 carry[4][None].astype(jnp.int32)], axis=0)
             return carry, packed
 
         self._prefill = _prefill
@@ -235,7 +244,7 @@ class Engine:
 
         t0 = time.perf_counter()
         self._rng, k0 = jax.random.split(self._rng)
-        first, ks, vs = self._prefill(
+        first_packed, ks, vs = self._prefill(
             self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
             sampling, k0,
         )
@@ -251,17 +260,20 @@ class Engine:
         lengths = jnp.asarray(seq_lens)
         is_real = np.zeros((bb,), dtype=bool)
         is_real[:n] = True
-        first_np = np.asarray(first)
+        first_packed_np = np.asarray(first_packed)      # ONE blocking read
+        first_np = first_packed_np[0]
+        first_lp_np = first_packed_np[1].view(np.float32)
         produced_np = is_real.astype(np.int32)          # the prefill sample
         hit = is_real & (first_np == eos) & (eos >= 0)
         active_np = is_real & ~hit & (produced_np < max_new_arr)
         first_np = np.where(is_real, first_np, -1)
 
-        jax.block_until_ready(first)
         ttft = time.perf_counter() - t0
         self.prefill_stats.add(ttft)
 
         out_tokens: List[List[int]] = [[int(first_np[i])] for i in range(n)]
+        out_lps: List[List[float]] = [[float(first_lp_np[i])]
+                                      for i in range(n)]
 
         active = jnp.asarray(active_np)
         produced = jnp.asarray(produced_np)
@@ -283,19 +295,22 @@ class Engine:
                 max_new_j, sampling, eos_j, kc, n_steps=n_steps,
             )
             packed_np = np.asarray(packed)   # ONE blocking read per chunk
-            toks_np = packed_np[:-1]                    # [n_steps, bb]
+            toks_np = packed_np[:n_steps]               # [n_steps, bb]
+            lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)
             act_host = packed_np[-1].astype(bool)
             for i in range(n):
                 for s in range(n_steps):
                     t = int(toks_np[s, i])
                     if t >= 0:
                         out_tokens[i].append(t)
+                        out_lps[i].append(float(lps_np[s, i]))
         decode_t = time.perf_counter() - t1
         self.decode_stats.add(decode_t)
 
         results = []
         for i, r in enumerate(requests):
             toks, stopped = trim_at_stops(out_tokens[i], r)
+            lps = out_lps[i][: len(toks)]
             self._total_prompt_tokens += len(r.prompt)
             self._total_generated_tokens += len(toks)
             results.append(
@@ -304,6 +319,7 @@ class Engine:
                     tokens=toks,
                     finish_reason="stop" if stopped else "length",
                     prompt_tokens=len(r.prompt),
+                    logprobs=lps,
                     ttft_s=ttft,
                     decode_s=decode_t,
                 )

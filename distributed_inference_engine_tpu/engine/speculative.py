@@ -115,7 +115,15 @@ class SpeculativeEngine:
             samp = jax.random.categorical(
                 key, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
             first = jnp.where(temps <= 0.0, logits.argmax(-1), samp)
-            return first.astype(jnp.int32), tks, tvs, dks, dvs
+            first = first.astype(jnp.int32)
+            # untempered model logprob of the chosen token, packed with it
+            # (one blocking read)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), first[:, None],
+                axis=-1)[:, 0]
+            packed = jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
+            return packed, tks, tvs, dks, dvs
 
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
         def _round(pt, pd, tck, tcv, dck, dcv,
@@ -220,11 +228,23 @@ class SpeculativeEngine:
             active = was_active & ~done
             lengths = jnp.where(was_active, lengths + n_acc + 1, lengths)
             last = jnp.where(was_active, final, last)
-            # pack emitted + n_acc + active into ONE output buffer: the
-            # host makes exactly one blocking read per round (each sync is
-            # a full round trip on tunnelled/remote devices)
+            # untempered model logprob of every emitted token: position j
+            # of t_logits is the distribution after window token j, which
+            # is exactly what emitted token j was conditioned on (the
+            # bonus/residual final at position n_acc included)
+            lp_all = jax.nn.log_softmax(t_logits, axis=-1)   # [B, k+1, V]
+            lp_emitted = jnp.take_along_axis(
+                lp_all, jnp.clip(emitted, 0, None)[:, :, None],
+                axis=-1)[..., 0]
+            lp_emitted = jnp.where(emitted >= 0, lp_emitted, 0.0)
+            # pack emitted + logprob bits + n_acc + active into ONE output
+            # buffer: the host makes exactly one blocking read per round
+            # (each sync is a full round trip on tunnelled/remote devices)
             packed = jnp.concatenate(
-                [emitted, n_acc[:, None], active.astype(jnp.int32)[:, None]],
+                [emitted,
+                 jax.lax.bitcast_convert_type(lp_emitted.astype(jnp.float32),
+                                              jnp.int32),
+                 n_acc[:, None], active.astype(jnp.int32)[:, None]],
                 axis=1)
             return (tck, tcv, dck, dcv, lengths, last,
                     active, produced, packed)
@@ -290,7 +310,9 @@ class SpeculativeEngine:
             jnp.asarray(tokens), jnp.asarray(seq_lens),
             jnp.asarray(temps), k0,
         )
-        first = np.asarray(first_dev)
+        fp = np.asarray(first_dev)                  # [2, bb]: tokens; lp bits
+        first = fp[0]
+        first_lp = fp[1].view(np.float32)
 
         L_t = self.spec.n_layers
         L_d = self.draft_spec.n_layers
@@ -310,7 +332,7 @@ class SpeculativeEngine:
         hit = is_real & (first == eos) & (eos >= 0)
         active_np = is_real & ~hit & (produced_np < max_new_arr)
         out_tokens: List[List[int]] = [[int(first[i])] for i in range(n)]
-        jax.block_until_ready(first_dev)
+        out_lps: List[List[float]] = [[float(first_lp[i])] for i in range(n)]
         ttft = time.perf_counter() - t0
         self.prefill_stats.add(ttft)
 
@@ -333,17 +355,20 @@ class SpeculativeEngine:
                 max_new_j, eos_j, temps_j, kr,
             )
             pk = np.asarray(packed)     # ONE blocking read per round
-            em = pk[:, : self.k + 1]
-            n_acc_np = pk[:, self.k + 1]
-            act_host = pk[:, self.k + 2].astype(bool)
+            k1 = self.k + 1
+            em = pk[:, :k1]
+            lps = np.ascontiguousarray(pk[:, k1: 2 * k1]).view(np.float32)
+            n_acc_np = pk[:, 2 * k1]
+            act_host = pk[:, 2 * k1 + 1].astype(bool)
             live = int((em[:, 0] >= 0).sum())
             self._total_rounds += 1
             self._total_accepted += int(n_acc_np[em[:, 0] >= 0].sum())
             self._total_proposed += self.k * live
             for i in range(n):
-                for t in em[i]:
-                    if t >= 0:
-                        out_tokens[i].append(int(t))
+                for j in range(k1):
+                    if em[i, j] >= 0:
+                        out_tokens[i].append(int(em[i, j]))
+                        out_lps[i].append(float(lps[i, j]))
         decode_t = time.perf_counter() - t1
         self.round_stats.add(decode_t)
 
@@ -355,6 +380,7 @@ class SpeculativeEngine:
             results.append(GenerationResult(
                 request_id=r.request_id or f"spec-{self._total_requests}-{i}",
                 tokens=toks,
+                logprobs=out_lps[i][: len(toks)],
                 finish_reason="stop" if stopped else "length",
                 prompt_tokens=len(r.prompt),
                 ttft_s=ttft,

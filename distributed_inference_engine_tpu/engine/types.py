@@ -86,6 +86,10 @@ class GenerationResult:
     tokens: List[int]                 # generated token ids (no prompt)
     finish_reason: str                # "stop" | "length"
     prompt_tokens: int = 0
+    # per generated token: log p(token | prefix) under the model's
+    # UNTEMPERED distribution (what scoring APIs report), aligned with
+    # ``tokens`` and trimmed identically
+    logprobs: List[float] = field(default_factory=list)
     # time to first token. Static/speculative engines measure from the
     # generate dispatch (prefill + first sample); the continuous engine
     # measures from SUBMIT, so queue wait under load is included.

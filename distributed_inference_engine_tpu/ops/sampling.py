@@ -115,3 +115,18 @@ def sample_tokens(
     stochastic = jnp.argmax(masked + gumbel, axis=-1)
     greedy = jnp.argmax(masked, axis=-1)
     return jnp.where(params.temperature <= 0.0, greedy, stochastic).astype(jnp.int32)
+
+
+def sample_tokens_with_logprobs(
+    logits: jnp.ndarray,        # [B, V] fp32
+    params: SamplingParams,
+    key: jax.Array,
+) -> tuple:
+    """``sample_tokens`` plus the chosen token's UNTEMPERED log-probability
+    ([B] fp32) — the quantity scoring/confidence APIs report (log p under
+    the model, independent of the sampling knobs used to pick the token)."""
+    toks = sample_tokens(logits, params, key)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(logp, toks[:, None].astype(jnp.int32),
+                                 axis=-1)[:, 0]
+    return toks, chosen
