@@ -17,9 +17,14 @@ Algorithm (Leviathan et al. / Chen et al. rejection sampling):
 3. **Accept.** Greedy requests accept while ``argmax p_i == d_i`` — the
    output is TOKEN-FOR-TOKEN the target's own greedy chain. Sampled
    requests accept d_i with prob ``min(1, p_i[d_i]/q_i[d_i])`` and resample
-   the first rejection from ``norm(max(p−q, 0))`` — distributionally exact
-   for temperature sampling (top-k/top-p knobs are ignored in speculative
-   mode; temperature is honored).
+   the first rejection from ``norm(max(p−q, 0))``. Both p and q are the
+   KNOB-MODIFIED distributions (temperature, then top-k/top-p/min-p masks,
+   renormalized — ``ops.sampling.masked_sampling_probs``): rejection
+   sampling is exact for whatever target distribution the acceptance ratio
+   uses, so masking p with the request's knobs makes the output
+   distributionally identical to the static engines' sampler, and masking
+   q the same way keeps the draft proposing inside the target's support
+   (acceptance never degrades from the draft proposing masked-out tokens).
 4. Rejected positions leave garbage KV past the accepted length in both
    caches; it is masked by the length bookkeeping and overwritten by the
    next round.
@@ -49,6 +54,11 @@ from ..models.base import (
     init_params,
     unembed,
 )
+from ..ops.sampling import (
+    SamplingParams,
+    masked_sampling_probs,
+    sample_tokens_with_logprobs,
+)
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .types import GenerationRequest, GenerationResult, trim_at_stops
@@ -69,6 +79,12 @@ class SpeculativeEngine:
         config: Optional[EngineConfig] = None,
         seed: int = 0,
         speculate_k: int = 4,
+        shard_fn=None,      # target params -> mesh-placed (parallel/sharding)
+        kv_sharding=None,   # NamedSharding for the dense [L,B,S,Hkv,Dh]
+                            # target caches (ModelShardings.kv); the DRAFT is
+                            # always replicated — it is small by design, and
+                            # tp-splitting it would trade negligible HBM for
+                            # per-layer collectives on the serial propose loop
     ) -> None:
         self.spec = spec.validate()
         self.draft_spec = draft_spec.validate()
@@ -86,6 +102,19 @@ class SpeculativeEngine:
             params = init_params(spec, jax.random.key(seed))
         if draft_params is None:
             draft_params = init_params(draft_spec, jax.random.key(seed + 100))
+        if shard_fn is not None:
+            params = shard_fn(params)
+        self._kv_sharding = kv_sharding
+        self._rep_sharding = None
+        if kv_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # replicate the draft explicitly on the SAME mesh — leaving it
+            # uncommitted would let XLA reshard it per dispatch
+            self._rep_sharding = NamedSharding(kv_sharding.mesh,
+                                               PartitionSpec())
+            draft_params = jax.tree.map(
+                lambda x: jax.device_put(x, self._rep_sharding), draft_params)
         self.params = params
         self.draft_params = draft_params
         self._rng = jax.random.key(seed + 1)
@@ -102,25 +131,15 @@ class SpeculativeEngine:
         spec_t, spec_d, k = self.spec, self.draft_spec, self.k
 
         @jax.jit
-        def _prefill_both(pt, pd, tokens, seq_lens, temps, key):
+        def _prefill_both(pt, pd, tokens, seq_lens, sampling, key):
             hid_t, tks, tvs = forward_prefill(spec_t, pt, tokens, seq_lens)
             _hid_d, dks, dvs = forward_prefill(spec_d, pd, tokens, seq_lens)
             b = tokens.shape[0]
             last = hid_t[jnp.arange(b), seq_lens - 1]
             logits = unembed(spec_t, pt, last)
-            # first token sampled in-program (temperature only — the
-            # speculative engine's contract)
-            temp = jnp.maximum(temps, 1e-4)[:, None]
-            probs = jax.nn.softmax(logits / temp, axis=-1)
-            samp = jax.random.categorical(
-                key, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1)
-            first = jnp.where(temps <= 0.0, logits.argmax(-1), samp)
-            first = first.astype(jnp.int32)
-            # untempered model logprob of the chosen token, packed with it
-            # (one blocking read)
-            lp = jnp.take_along_axis(
-                jax.nn.log_softmax(logits, axis=-1), first[:, None],
-                axis=-1)[:, 0]
+            # first token drawn by the SAME sampler as the other engines
+            # (full knob set), packed with its logprob (one blocking read)
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
             packed = jnp.stack(
                 [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
             return packed, tks, tvs, dks, dvs
@@ -128,7 +147,7 @@ class SpeculativeEngine:
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
         def _round(pt, pd, tck, tcv, dck, dcv,
                    lengths, last, active, produced,
-                   max_new, eos_ids, temps, key):
+                   max_new, eos_ids, sampling, key):
             """One speculative round for every slot. Shapes:
             tck/tcv [L,B,S,..] target cache; dck/dcv draft cache;
             per-slot int32/bool vectors. Returns updated state + emitted
@@ -151,13 +170,15 @@ class SpeculativeEngine:
             )
             q_logits = d_logits0[:, 0]                           # [B, V]
 
-            # --- 2. propose k tokens; q_probs collected per step
-            temp = jnp.maximum(temps, 1e-4)[:, None]
-            greedy = (temps <= 0.0)[:, None]
+            # --- 2. propose k tokens; q_probs collected per step. Both q
+            # (here) and p (below) are the knob-MODIFIED distributions —
+            # identical masking is what makes the acceptance ratio exact
+            # for the request's actual sampling settings.
+            greedy = (sampling.temperature <= 0.0)[:, None]
 
             def propose(carry, step_key):
                 dck, dcv, q_logits, pos = carry
-                probs = jax.nn.softmax(q_logits / temp, axis=-1)
+                probs = masked_sampling_probs(q_logits, sampling)
                 d_samp = jax.random.categorical(step_key, jnp.log(
                     jnp.maximum(probs, 1e-30)), axis=-1)
                 d_tok = jnp.where(greedy[:, 0], q_logits.argmax(-1), d_samp)
@@ -179,7 +200,7 @@ class SpeculativeEngine:
                 spec_t, pt, window_t, jnp.full_like(lengths, k + 1),
                 lengths, tck, tcv,
             )                                                    # [B, k+1, V]
-            p_probs = jax.nn.softmax(t_logits / temp[:, :, None], axis=-1)
+            p_probs = masked_sampling_probs(t_logits, sampling)
 
             # --- 4. acceptance
             p_at_d = jnp.take_along_axis(
@@ -261,7 +282,6 @@ class SpeculativeEngine:
         self._total_rounds = 0
         self._total_accepted = 0
         self._total_proposed = 0
-        self._warned_topk = False
 
     # ------------------------------------------------------------ generate
 
@@ -270,13 +290,6 @@ class SpeculativeEngine:
             return []
         if min(len(r.prompt) for r in requests) < 1:
             raise ValueError("empty prompt")
-        if any(r.top_k > 0 or r.top_p < 1.0 or r.min_p > 0.0
-               for r in requests) and not self._warned_topk:
-            self._warned_topk = True
-            logger.warning(
-                "speculative engine honors temperature only — top_k/top_p/"
-                "min_p on these requests are ignored (rejection sampling is "
-                "exact for the temperature-adjusted distribution)")
         self._total_requests += len(requests)
         n = len(requests)
         bb = _next_bucket(n, self.batch_buckets)
@@ -294,6 +307,9 @@ class SpeculativeEngine:
         max_new_arr = np.zeros((bb,), dtype=np.int32)
         eos = np.full((bb,), -1, dtype=np.int32)
         temps = np.zeros((bb,), dtype=np.float32)
+        top_k = np.zeros((bb,), dtype=np.int32)
+        top_p = np.ones((bb,), dtype=np.float32)
+        min_p = np.zeros((bb,), dtype=np.float32)
         for i, r in enumerate(requests):
             p = r.prompt[-tb:]
             tokens[i, : len(p)] = p
@@ -302,13 +318,20 @@ class SpeculativeEngine:
                                         total_cap - len(p) - self.k - 1))
             eos[i] = r.eos_id
             temps[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            min_p[i] = r.min_p
+        sampling = SamplingParams(
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(min_p),
+        )
 
         t0 = time.perf_counter()
         self._rng, k0 = jax.random.split(self._rng)
         first_dev, tks, tvs, dks, dvs = self._prefill_both(
             self.params, self.draft_params,
             jnp.asarray(tokens), jnp.asarray(seq_lens),
-            jnp.asarray(temps), k0,
+            sampling, k0,
         )
         fp = np.asarray(first_dev)                  # [2, bb]: tokens; lp bits
         first = fp[0]
@@ -321,10 +344,14 @@ class SpeculativeEngine:
                    self.spec.head_dim)
         shape_d = (L_d, bb, total_cap, self.draft_spec.n_kv_heads,
                    self.draft_spec.head_dim)
-        tck = jnp.zeros(shape_t, dt).at[:, :, :tb].set(tks.astype(dt))
-        tcv = jnp.zeros(shape_t, dt).at[:, :, :tb].set(tvs.astype(dt))
-        dck = jnp.zeros(shape_d, dt).at[:, :, :tb].set(dks.astype(dt))
-        dcv = jnp.zeros(shape_d, dt).at[:, :, :tb].set(dvs.astype(dt))
+        # target caches follow the tp/kv sharding; draft caches replicate
+        # with their (replicated) params
+        tdev = {"device": self._kv_sharding} if self._kv_sharding else {}
+        ddev = {"device": self._rep_sharding} if self._rep_sharding else {}
+        tck = jnp.zeros(shape_t, dt, **tdev).at[:, :, :tb].set(tks.astype(dt))
+        tcv = jnp.zeros(shape_t, dt, **tdev).at[:, :, :tb].set(tvs.astype(dt))
+        dck = jnp.zeros(shape_d, dt, **ddev).at[:, :, :tb].set(dks.astype(dt))
+        dcv = jnp.zeros(shape_d, dt, **ddev).at[:, :, :tb].set(dvs.astype(dt))
 
         is_real = np.zeros((bb,), bool)
         is_real[:n] = True
@@ -342,7 +369,6 @@ class SpeculativeEngine:
         produced = jnp.asarray(produced_np)
         max_new_j = jnp.asarray(max_new_arr)
         eos_j = jnp.asarray(eos)
-        temps_j = jnp.asarray(temps)
 
         t1 = time.perf_counter()
         act_host = active_np
@@ -352,7 +378,7 @@ class SpeculativeEngine:
              produced, packed) = self._round(
                 self.params, self.draft_params, tck, tcv, dck, dcv,
                 lengths, last, active, produced,
-                max_new_j, eos_j, temps_j, kr,
+                max_new_j, eos_j, sampling, kr,
             )
             pk = np.asarray(packed)     # ONE blocking read per round
             k1 = self.k + 1

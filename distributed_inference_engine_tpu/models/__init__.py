@@ -91,15 +91,13 @@ def engine_from_config(cfg):
     if want_mesh:
         import jax as _jax
 
-        if cfg.quantized:
+        if int(cfg.metadata.get("speculative", 0)) and (sp > 1 or dp > 1):
             raise ValueError(
-                "quantized + mesh metadata (tp/sp/dp) is not supported "
-                "yet — the int8 QuantizedTensor tree has no sharding "
-                "recipe; deploy quantized models unsharded")
-        if int(cfg.metadata.get("speculative", 0)):
-            raise ValueError(
-                "speculative decoding does not support mesh metadata "
-                "(tp/sp/dp) yet — deploy it unsharded")
+                "speculative decoding composes with tp only (target "
+                "sharded, draft replicated); sp/dp shard the prefill "
+                "batch/sequence, which the speculative window forwards "
+                "do not — drop sp/dp or deploy replicas via the load "
+                "balancer")
         if dp > 1 and sp <= 1:
             raise ValueError(
                 "dp metadata only composes with sp (the sequence-parallel "
@@ -226,9 +224,13 @@ def engine_from_config(cfg):
             if cfg.dtype:
                 d_spec = d_spec.replace(dtype=cfg.dtype)
             d_params = None
+        # dense [L,B,S,Hkv,Dh] target-cache sharding (shardings was built
+        # alongside shard_fn above whenever a mesh was requested)
+        spec_kv = shardings.kv if want_mesh else None
         return SpeculativeEngine(spec, d_spec, params=params,
                                  draft_params=d_params, config=ecfg,
-                                 speculate_k=spec_k)
+                                 speculate_k=spec_k, shard_fn=shard_fn,
+                                 kv_sharding=spec_kv)
     if cfg.metadata.get("role") == "prefill":
         # disaggregated prefill pool: prefill-only engine (engine/disagg.py);
         # sp here gives the pool sequence-parallel ring-attention prefill

@@ -136,13 +136,37 @@ class ModelShardings:
         return lambda params: shard_params(params, self)
 
 
+def scale_sharding(scale_shape, weight_sharding: NamedSharding) -> NamedSharding:
+    """Sharding for an int8 weight's per-channel scale: the weight's spec
+    with every axis over a size-1 (contracted, keepdims) dim dropped.
+
+    Output channels keep the weight's placement — e.g. a column-parallel
+    ``wq`` [L, D, H·Dh]@P(∅,∅,tp) gives its scale [L, 1, H·Dh] the same
+    tp split, so the fused ``y * scale`` in ``ops.quant.matmul_any`` is
+    chip-local; a row-parallel ``wo``'s scale [L, 1, D] drops the tp axis
+    (its contracted dim is the sharded one) and replicates.
+    """
+    spec = list(weight_sharding.spec) + [None] * (
+        len(scale_shape) - len(weight_sharding.spec))
+    new = [None if scale_shape[d] == 1 else spec[d]
+           for d in range(len(scale_shape))]
+    return NamedSharding(weight_sharding.mesh, P(*new))
+
+
 def shard_params(params: Params, shardings: ModelShardings) -> Params:
     """Place a param tree onto the mesh per the spec tree.
+
+    ``QuantizedTensor`` leaves (weight-only int8, ``ops/quant.py``) place
+    their int8 payload exactly like the bf16 weight would and derive the
+    scale's sharding from it — the VERDICT r1 "sharding recipe" that lets
+    ``quantized`` compose with tp/sp/dp.
 
     Divisibility guard: a tp-sharded dim that doesn't divide by the axis size
     is a config error worth a clear message (XLA's would be cryptic).
     """
-    def place(x, s: NamedSharding):
+    from ..ops.quant import QuantizedTensor
+
+    def place_arr(x, s: NamedSharding):
         for dim, axes in enumerate(s.spec):
             if axes is None:
                 continue
@@ -157,4 +181,13 @@ def shard_params(params: Params, shardings: ModelShardings) -> Params:
                 )
         return jax.device_put(x, s)
 
-    return jax.tree.map(place, params, shardings.params)
+    def place(x, s: NamedSharding):
+        if isinstance(x, QuantizedTensor):
+            return QuantizedTensor(
+                q=place_arr(x.q, s),
+                s=jax.device_put(x.s, scale_sharding(x.s.shape, s)),
+            )
+        return place_arr(x, s)
+
+    return jax.tree.map(place, params, shardings.params,
+                        is_leaf=lambda x: isinstance(x, QuantizedTensor))

@@ -24,6 +24,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite compiles hundreds of XLA programs
+# (mesh variants × bucket shapes) on one CPU core; caching them across test
+# processes and across runs is the single biggest suite-time lever
+# (VERDICT r1 item 8). Keyed by HLO, so spec shrinkage elsewhere still
+# invalidates correctly.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_TEST_CACHE_DIR", "/tmp/jax_test_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
