@@ -48,6 +48,7 @@ from ..utils.tracing import LatencyStats
 from .types import (  # noqa: F401  (re-export)
     GenerationRequest,
     GenerationResult,
+    scan_host_stops,
     trim_at_stops,
 )
 
@@ -288,6 +289,7 @@ class Engine:
         # a device-side active.any() would cost one extra round trip per
         # chunk
         act_host = active_np
+        scanned = [0] * n        # host-stop scan resume offsets
         while act_host.any():
             self._rng, kc = jax.random.split(self._rng)
             (ck, cv, lengths, last, active, produced), packed = self._decode_chunk(
@@ -304,6 +306,16 @@ class Engine:
                     if t >= 0:
                         out_tokens[i].append(t)
                         out_lps[i].append(float(lps_np[s, i]))
+            # early exit on host-side stops (ADVICE r1): the device loop
+            # only knows eos_id, so a request whose stop_ids/stop_sequences
+            # matched would otherwise burn decode chunks to max_new_tokens
+            # and be trimmed after the fact. One batched flag clear —
+            # skipped when the loop is exiting anyway.
+            stopped_rows = scan_host_stops(out_tokens, requests, act_host,
+                                           scanned)
+            if stopped_rows and act_host.any():
+                active = active.at[
+                    jnp.asarray(stopped_rows, jnp.int32)].set(False)
         decode_t = time.perf_counter() - t1
         self.decode_stats.add(decode_t)
 

@@ -61,7 +61,12 @@ from ..ops.sampling import (
 )
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
-from .types import GenerationRequest, GenerationResult, trim_at_stops
+from .types import (
+    GenerationRequest,
+    GenerationResult,
+    scan_host_stops,
+    trim_at_stops,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -372,6 +377,7 @@ class SpeculativeEngine:
 
         t1 = time.perf_counter()
         act_host = active_np
+        scanned = [0] * n        # host-stop scan resume offsets
         while act_host.any():
             self._rng, kr = jax.random.split(self._rng)
             (tck, tcv, dck, dcv, lengths, last, active,
@@ -395,6 +401,15 @@ class SpeculativeEngine:
                     if em[i, j] >= 0:
                         out_tokens[i].append(int(em[i, j]))
                         out_lps[i].append(float(lps[i, j]))
+            # early exit on host-side stops (ADVICE r1): the device round
+            # only knows eos_id — a matched stop_ids/stop_sequences request
+            # would otherwise keep burning target+draft rounds to
+            # max_new_tokens before the post-hoc trim
+            stopped_rows = scan_host_stops(out_tokens, requests, act_host,
+                                           scanned)
+            if stopped_rows and act_host.any():
+                active = active.at[
+                    jnp.asarray(stopped_rows, jnp.int32)].set(False)
         decode_t = time.perf_counter() - t1
         self.round_stats.add(decode_t)
 

@@ -66,6 +66,24 @@ def find_stop_cut(tokens: List[int], req: "GenerationRequest",
     return cut
 
 
+def scan_host_stops(out_tokens: List[List[int]], requests, act_host,
+                    scanned: List[int]) -> List[int]:
+    """Per-chunk host-side stop scan shared by the static and speculative
+    decode loops (ADVICE r1 early exit): for each still-active request with
+    stop_ids/stop_sequences, check only its newly appended tokens; matched
+    rows are cleared in ``act_host`` (the loop condition) and returned so
+    the caller can batch-clear the device flags. ``scanned`` is the
+    per-request resume offset, advanced here."""
+    stopped: List[int] = []
+    for i, r in enumerate(requests):
+        if act_host[i] and (r.stop_ids or r.stop_sequences):
+            if find_stop_cut(out_tokens[i], r, start=scanned[i]) >= 0:
+                stopped.append(i)
+                act_host[i] = False
+        scanned[i] = len(out_tokens[i])
+    return stopped
+
+
 def trim_at_stops(tokens: List[int], req: "GenerationRequest"
                   ) -> Tuple[List[int], bool]:
     """Cap at ``max_new_tokens`` and cut at the EARLIEST stop condition,

@@ -184,3 +184,56 @@ def test_min_p_out_of_range_is_clamped_not_noise():
     toks = {int(sample_tokens(logits, params, jax.random.key(i))[0])
             for i in range(32)}
     assert toks == {0}
+
+
+# ---------------------------------------------------- early stop exit
+
+
+def _count_calls(engine, attr):
+    orig = getattr(engine, attr)
+    box = {"n": 0}
+
+    def wrapper(*a, **kw):
+        box["n"] += 1
+        return orig(*a, **kw)
+
+    setattr(engine, attr, wrapper)
+    return box
+
+
+def test_static_engine_exits_decode_early_on_host_stop():
+    """ADVICE r1: a stop_ids match must END the decode loop, not just trim
+    afterwards — a request with a large max_new_tokens and an early stop
+    otherwise burns the full decode budget in wasted chunks."""
+    eng = Engine(SPEC, config=EngineConfig(**ECFG), seed=0)
+    base = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
+                              temperature=0.0)])[0].tokens
+    stop = base[2]                       # stop lands inside chunk one
+    calls = _count_calls(eng, "_decode_chunk")
+    out = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
+                             temperature=0.0, stop_ids=[stop])])[0]
+    assert out.tokens == base[:3]
+    assert out.finish_reason == "stop"
+    # 3 tokens at 4 steps/chunk: the stop is inside the first chunk; 40
+    # max_new would have been 10 chunks
+    assert calls["n"] == 1, f"decode ran {calls['n']} chunks after the stop"
+
+
+def test_speculative_engine_exits_rounds_early_on_host_stop():
+    """Same contract for the speculative engine's target+draft rounds."""
+    from distributed_inference_engine_tpu.engine.speculative import (
+        SpeculativeEngine,
+    )
+
+    eng = SpeculativeEngine(SPEC, SPEC, config=EngineConfig(**ECFG),
+                            speculate_k=3, seed=0)
+    eng.draft_params = eng.params       # identical draft: all accepted
+    base = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
+                              temperature=0.0)])[0].tokens
+    stop = base[2]
+    calls = _count_calls(eng, "_round")
+    out = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
+                             temperature=0.0, stop_ids=[stop])])[0]
+    assert out.tokens == base[:3]
+    assert out.finish_reason == "stop"
+    assert calls["n"] <= 2, f"{calls['n']} rounds ran after the stop"
