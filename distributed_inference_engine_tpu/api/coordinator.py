@@ -100,6 +100,20 @@ class Coordinator:
             policy=self.config.cache.policy,
             default_ttl=self.config.cache.default_ttl,
         )
+        persist = self.config.cache.persist_path
+        if persist:
+            import os
+
+            if os.path.exists(persist):
+                # best-effort: a stale/corrupt snapshot must not block
+                # startup — the cache is an optimization, not state of record
+                try:
+                    n = self.cache.load(persist)
+                    logger.info("restored %d cache entries from %s",
+                                n, persist)
+                except Exception:
+                    logger.exception("cache restore from %s failed — "
+                                     "starting cold", persist)
         self.batcher = Batcher(
             batch_callback=self._run_batch,
             max_batch_size=self.config.batcher.max_batch_size,
@@ -700,8 +714,8 @@ class Coordinator:
         ``src/model_registry.py:192-249``, finally given file IO), fleet
         membership, model configs and disaggregated pools."""
         import json
-        import os
-        import tempfile
+
+        from ..utils.files import atomic_write
 
         state = {
             "version": 1,
@@ -719,18 +733,19 @@ class Coordinator:
             },
         }
         # atomic replace: a crash mid-write must not corrupt the snapshot
-        d = os.path.dirname(os.path.abspath(path)) or "."
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".state-")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(state, f, indent=2)
-            os.replace(tmp, path)
-        except BaseException:
+        atomic_write(path, lambda f: json.dump(state, f, indent=2))
+        if self.config.cache.persist_path:
+            # cache snapshot rides the state snapshot (its own file: pickle
+            # payloads don't belong inside the JSON control-plane record).
+            # Best-effort, symmetric with the startup-side load: the cache
+            # is an optimization — its save failing must not fail the
+            # control-plane snapshot that already landed
             try:
-                os.unlink(tmp)          # don't litter on serialize failure
-            except OSError:
-                pass
-            raise
+                self.cache.save(self.config.cache.persist_path)
+            except Exception:
+                logger.exception("cache snapshot to %s failed — control-"
+                                 "plane state was saved",
+                                 self.config.cache.persist_path)
         return path
 
     async def restore_state(self, path: str, redeploy: bool = False,

@@ -119,6 +119,11 @@ class CacheConfig:
     max_size: int = 1024
     policy: str = "lru"                # "lru" | "lfu" | "fifo"
     default_ttl: Optional[float] = None
+    # optional persistence (the reference README's declared-but-unbuilt
+    # surface, ``/root/reference/README.md:14,90``): when set, the
+    # coordinator restores the cache from this file at startup and
+    # snapshots it alongside ``save_state``
+    persist_path: Optional[str] = None
 
 
 @dataclass

@@ -167,6 +167,68 @@ class ResponseCache:
         # LFU: smallest access count; ties broken by age (iteration order)
         return min(self._entries.items(), key=lambda kv: kv[1].access_count)[0]
 
+    # --------------------------------------------------------- persistence
+
+    def save(self, path: str) -> int:
+        """Persist live entries to ``path`` (the "optional persistence" the
+        reference README declares for its KV store but never implements —
+        ``/root/reference/README.md:14,90``). Returns entries written.
+
+        TTLs are stored as REMAINING seconds: ``created_at`` is
+        ``time.monotonic()``, which is meaningless across processes, so an
+        entry with 30 s left saves as 30 and its clock restarts on load.
+        Expired entries are dropped at save. Pickle format (values are
+        arbitrary Python response payloads); written atomically so a crash
+        mid-write can't corrupt a previous snapshot."""
+        import pickle
+
+        from ..utils.files import atomic_write
+
+        with self._lock:
+            self._check_open()
+            now = time.monotonic()
+            rows = []
+            for k, e in self._entries.items():   # preserves eviction order
+                if e.is_expired(now):
+                    continue
+                remaining = (None if e.ttl is None
+                             else max(0.0, e.ttl - (now - e.created_at)))
+                rows.append((k, e.value, remaining, e.access_count))
+        payload = {"version": 1, "policy": self.policy.value, "rows": rows}
+        atomic_write(path, lambda f: pickle.dump(payload, f), binary=True)
+        return len(rows)
+
+    def load(self, path: str) -> int:
+        """Restore a ``save`` snapshot into this cache: loaded keys
+        overwrite, other existing entries are kept, capacity eviction
+        applies normally. Entries whose remaining TTL reached zero are
+        skipped. Returns entries restored."""
+        import pickle
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        version = payload.get("version")
+        if version != 1:
+            # the version field exists exactly so a format bump fails with
+            # a clear message, not an unpack error deep in the row loop
+            raise ValueError(
+                f"cache snapshot {path!r} has format version {version!r}; "
+                "this build reads version 1")
+        n = 0
+        with self._lock:
+            self._check_open()
+            for k, value, remaining, access_count in payload["rows"]:
+                if remaining is not None and remaining <= 0:
+                    continue
+                if k in self._entries:
+                    del self._entries[k]
+                self._evict_if_needed()
+                entry = CacheEntry(value=value, ttl=remaining)
+                entry.access_count = access_count
+                self._entries[k] = entry
+                n += 1
+        return n
+
     # --------------------------------------------------------------- stats
 
     def get_stats(self) -> Dict[str, Any]:

@@ -1,0 +1,37 @@
+"""Filesystem helpers shared by every snapshot writer.
+
+One definition of the atomic-write dance (tempfile in the target dir →
+write → ``os.replace``) so the coordinator state snapshot, the response
+cache snapshot, and future writers cannot drift on crash semantics: a
+failure mid-write must leave any previous file intact, and a crash must
+not litter half-written temp files that later reads could mistake for
+snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, IO
+
+
+def atomic_write(path: str, write_fn: Callable[[IO], None],
+                 binary: bool = False) -> str:
+    """Write ``path`` atomically: ``write_fn(f)`` fills a temp file in the
+    same directory, then ``os.replace`` swaps it in. On any failure the
+    temp file is removed and the previous ``path`` (if any) is untouched.
+    Returns ``path``."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix="." + os.path.basename(path)
+                               + "-")
+    try:
+        with os.fdopen(fd, "wb" if binary else "w") as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -193,3 +193,55 @@ def test_expiry_during_eviction_counts_as_expiration():
     c.set("new", 3)
     s = c.get_stats()
     assert s["expirations"] == 1 and s["evictions"] == 0
+
+
+# ------------------------------------------------------------- persistence
+
+
+def test_save_load_roundtrip_entries_and_ttls(tmp_path):
+    """VERDICT r1 item 9 / reference README's declared 'optional
+    persistence': a restart round-trips entries, and TTLs persist as
+    REMAINING time (monotonic created_at can't cross processes)."""
+    p = str(tmp_path / "cache.pkl")
+    c = ResponseCache(max_size=10)
+    c.set("plain", {"tokens": [1, 2, 3]})
+    c.set("ttl", "v", ttl=30.0)
+    c.set("dead", "x", ttl=0.01)
+    time.sleep(0.05)                      # "dead" expires before save
+    assert c.save(p) == 2
+
+    c2 = ResponseCache(max_size=10)
+    assert c2.load(p) == 2
+    assert c2.get("plain") == {"tokens": [1, 2, 3]}
+    assert c2.get("ttl") == "v"
+    assert c2.get("dead") is None
+    # remaining TTL carried over: well under the original 30 s
+    e = c2._entries["ttl"]
+    assert e.ttl is not None and 25.0 < e.ttl <= 30.0
+    # no-TTL entry stays immortal
+    assert c2._entries["plain"].ttl is None
+
+
+def test_load_respects_capacity_and_overwrites(tmp_path):
+    p = str(tmp_path / "cache.pkl")
+    big = ResponseCache(max_size=10)
+    for i in range(6):
+        big.set(f"k{i}", i)
+    big.save(p)
+    small = ResponseCache(max_size=4)
+    small.set("k0", "old")
+    small.load(p)
+    assert len(small) <= 4                # capacity enforced during load
+    assert small.get("k5") == 5           # newest snapshot entries survive
+    assert small.get("k0") != "old" or small.get("k0") is None
+
+
+def test_save_is_atomic_over_existing_snapshot(tmp_path):
+    p = str(tmp_path / "cache.pkl")
+    c = ResponseCache()
+    c.set("a", 1)
+    c.save(p)
+    c.set("b", 2)
+    c.save(p)                             # overwrite in place
+    c2 = ResponseCache()
+    assert c2.load(p) == 2
