@@ -1,24 +1,35 @@
 """Benchmark entry point — run by the driver on real TPU hardware.
 
 Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 Diagnostics go to stderr.
 
-What it measures: steady-state decode throughput (output tok/s) of the JAX
-engine on GPT-2-124M (BASELINE.json configs[1] — the single-chip rung of the
-config ladder), batch = 8 slots, greedy sampling, random-init weights
-(weights' values don't change the FLOP count; zero-egress environment has no
-checkpoint on disk).
+Default rung (BASELINE.md ladder rung 3-4, VERDICT r1 item 1): steady-state
+decode throughput of an **8B-class Llama-shaped model, int8 weight-only,
+continuous engine with paged KV** on one v5e chip — random-init (weights'
+values don't change the FLOP/byte counts; zero-egress environment has no
+checkpoint on disk). Alongside tok/s it reports the HBM roofline:
+``hbm_util`` = achieved bytes/s ÷ the chip's ~819 GB/s — decode is
+bandwidth-bound, so this is the honest "how much headroom is left" number.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md — its
-"model" is an asyncio sleep). The only quantitative anchor is its simulated
-serving ceiling: FakeModel takes 50–150 ms per request and emits one echo per
-request (`/root/reference/src/mock_models/fake_model.py:47`), i.e. at best
-20 responses/s per worker. We count one echo as one output token —
-generously — so vs_baseline = (our output tok/s) / 20.
+"model" is an asyncio sleep), so this repo's north star is the denominator:
+BASELINE.json's ≥1,000 output tok/s target for the 8B class. (Round 1
+divided by the mock's simulated 20 responses/s — a vacuous ratio, retired.)
+
+Env knobs:
+    BENCH_MODEL    spec name (default llama3-8b; gpt2 = round-1 rung)
+    BENCH_QUANT    1 = int8 weight-only (default: 1 for 8B-class, else 0)
+    BENCH_ENGINE   continuous (default) | static | serving
+    BENCH_BATCH    decode slots (default 8)
+    BENCH_PROMPT / BENCH_NEW_TOKENS   lengths (default 128 / 128)
+    BENCH_KV_DTYPE paged-KV dtype (continuous; default bfloat16)
+    serving mode:  BENCH_RATE (req/s Poisson, default 16),
+                   BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16)
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -27,6 +38,22 @@ import time
 # Benchmark runs on the real chip — do NOT import tests/conftest (which pins
 # CPU). Keep XLA cache warm across runs where the driver allows it.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+V5E_HBM_GBPS = 819.0          # v5e peak HBM bandwidth
+NORTH_STAR_TOKS = 1000.0      # BASELINE.json: >=1k output tok/s, 8B class
+
+MODEL = os.environ.get("BENCH_MODEL", "llama3-8b")
+IS_BIG = "8b" in MODEL or "7b" in MODEL
+QUANT = os.environ.get("BENCH_QUANT", "1" if IS_BIG else "0") == "1"
+ENGINE_KIND = os.environ.get("BENCH_ENGINE", "continuous")
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "128"))
+NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def _probe_tpu(timeout_s: float = 120.0) -> bool:
@@ -44,16 +71,238 @@ def _probe_tpu(timeout_s: float = 120.0) -> bool:
     except (subprocess.TimeoutExpired, OSError):
         return False
 
-REFERENCE_SIM_CEILING_TOKS = 20.0   # see module docstring
 
-BATCH = int(os.environ.get("BENCH_BATCH", "8"))
-PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "128"))
-NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
-MODEL = os.environ.get("BENCH_MODEL", "gpt2")   # gpt2 = 124M
+def _spec():
+    from distributed_inference_engine_tpu.models import spec_for_architecture
+
+    return spec_for_architecture(MODEL)
 
 
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+def _build_params(spec, quant: bool):
+    import jax
+
+    from distributed_inference_engine_tpu.ops.quant import (
+        random_quantized_params,
+    )
+
+    if not quant:
+        return None                      # engine does its own random init
+    return random_quantized_params(spec, jax.random.key(0))
+
+
+def _engine(spec, params, kind: str, batch: int, steps: int):
+    from distributed_inference_engine_tpu.config import EngineConfig
+
+    cfg = EngineConfig(
+        max_slots=batch,
+        max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
+        prefill_buckets=[PROMPT_LEN],
+        decode_steps_per_call=steps,
+    )
+    if os.environ.get("BENCH_KV_DTYPE"):
+        cfg.kv_dtype = os.environ["BENCH_KV_DTYPE"]
+    if kind == "static":
+        from distributed_inference_engine_tpu.engine.engine import Engine
+
+        return Engine(spec, params=params, config=cfg)
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+
+    cfg.page_size = 128
+    per_seq = -(-(PROMPT_LEN + NEW_TOKENS) // cfg.page_size)  # ceil
+    cfg.num_pages = max(64, batch * per_seq + 8)
+    return ContinuousEngine(spec, params=params, config=cfg)
+
+
+def _roofline(spec, params, batch: int, toks_per_s: float,
+              kv_dtype_bytes: int) -> dict:
+    """Streamed bytes per decode step → fraction of the chip's HBM peak.
+
+    Weights stream fully each step EXCEPT the token embedding (a gather of
+    ``batch`` rows; when embeddings are tied the unembed matmul streams the
+    table, so it counts). KV reads grow with context: mean over the decode
+    phase ≈ prompt + new/2 tokens per slot.
+    """
+    from distributed_inference_engine_tpu.ops.quant import param_bytes
+
+    total = param_bytes(params)
+    emb_bytes = 0
+    if not spec.tie_embeddings:
+        emb = params["tok_emb"]
+        emb_bytes = emb.size * emb.dtype.itemsize
+    kv_per_token = (2 * spec.n_layers * spec.n_kv_heads * spec.head_dim
+                    * kv_dtype_bytes)
+    mean_ctx = PROMPT_LEN + NEW_TOKENS / 2
+    step_bytes = (total - emb_bytes) + batch * mean_ctx * kv_per_token
+    steps_per_s = toks_per_s / batch
+    gbps = step_bytes * steps_per_s / 1e9
+    return {
+        "param_gib": round(total / (1 << 30), 2),
+        "step_mb": round(step_bytes / 1e6, 1),
+        "achieved_gbps": round(gbps, 1),
+        "hbm_util": round(gbps / V5E_HBM_GBPS, 3),
+    }
+
+
+def _requests(spec, seed: int, n: int):
+    import numpy as np
+
+    from distributed_inference_engine_tpu.engine.types import (
+        GenerationRequest,
+    )
+
+    rs = np.random.RandomState(seed)
+    return [
+        GenerationRequest(
+            prompt=rs.randint(0, spec.vocab_size, size=PROMPT_LEN).tolist(),
+            max_new_tokens=NEW_TOKENS,
+            temperature=0.0,
+            request_id=f"bench-{seed}-{i}",
+        )
+        for i in range(n)
+    ]
+
+
+def decode_main() -> None:
+    """Batch-decode throughput rung (static or continuous engine)."""
+    spec = _spec()
+    steps = int(os.environ.get("BENCH_STEPS", str(NEW_TOKENS)))
+    t0 = time.perf_counter()
+    params = _build_params(spec, QUANT)
+    engine = _engine(spec, params, ENGINE_KIND, BATCH, steps)
+    log(f"engine init ({MODEL}, {ENGINE_KIND}, int8={QUANT}): "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    engine.generate(_requests(spec, 1, BATCH))   # compile all programs
+    log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
+
+    best_toks = 0.0
+    ttfts = []
+    for r in range(RUNS):
+        t0 = time.perf_counter()
+        results = engine.generate(_requests(spec, 100 + r, BATCH))
+        wall = time.perf_counter() - t0
+        gen = sum(len(x.tokens) for x in results)
+        decode_s = results[0].decode_s
+        toks = (gen - len(results)) / decode_s   # first token is prefill's
+        ttfts.append(results[0].ttft_s)
+        log(f"run {r}: {gen} tokens, e2e {wall:.2f}s "
+            f"({gen / wall:.1f} tok/s e2e), decode {decode_s:.2f}s -> "
+            f"{toks:.1f} tok/s (ttft {results[0].ttft_s * 1e3:.1f} ms)")
+        best_toks = max(best_toks, toks)
+
+    kv_bytes = 1 if getattr(engine.config, "kv_dtype", "") == "float8_e4m3fn" \
+        else 2
+    roof = _roofline(spec, engine.params, BATCH, best_toks, kv_bytes)
+    ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1e3
+    log(f"p50 TTFT: {ttft_ms:.1f} ms; roofline: {roof}")
+    print(json.dumps({
+        "metric": f"decode_throughput_{MODEL}{'_int8' if QUANT else ''}"
+                  f"_bs{BATCH}",
+        "value": round(best_toks, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(best_toks / NORTH_STAR_TOKS, 2),
+        "hbm_util": roof["hbm_util"],
+        "achieved_gbps": roof["achieved_gbps"],
+        "ttft_p50_ms": round(ttft_ms, 1),
+    }), flush=True)
+
+
+def serving_main() -> None:
+    """Serving load test (VERDICT r1 item 5): Poisson arrivals through
+    ``EnginePump`` — N independent clients, each streaming one request —
+    measuring throughput, TTFT p50/p99 (from submit, queue wait included),
+    streaming ITL p99, and decode-batch occupancy."""
+    import asyncio
+
+    import numpy as np
+
+    from distributed_inference_engine_tpu.serving.pump import EnginePump
+
+    spec = _spec()
+    # default offered load ~near capacity: an 8B chip serves ~4 requests/s
+    # of 128 fresh tokens; small models far more
+    rate = float(os.environ.get("BENCH_RATE", "4" if IS_BIG else "16"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "16"))
+
+    t0 = time.perf_counter()
+    params = _build_params(spec, QUANT)
+    engine = _engine(spec, params, "continuous", BATCH, steps)
+    log(f"engine init ({MODEL}, serving, int8={QUANT}): "
+        f"{time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    # Poisson arrivals admit in small bursts: EVERY pow2 admission bucket
+    # must be compiled before the clock starts, not just bb=BATCH
+    engine.warmup(max_new_tokens=2)
+    log(f"warmup (compile all buckets): {time.perf_counter() - t0:.1f}s")
+
+    pump = EnginePump(engine, idle_wait_s=0.01)
+    reqs = _requests(spec, 7, n_requests)
+    itls: list = []
+    ttfts: list = []
+    # occupancy must cover the MEASURED window only — warmup ticks the
+    # engine's cumulative counters too
+    m0 = engine.get_metrics()
+    steps0 = m0["engine_steps"]
+    occ_sum0 = m0["batch_occupancy"] * steps0 * engine.max_slots
+
+    async def client(req):
+        marks = []
+
+        def on_tokens(toks):
+            marks.append((time.perf_counter(), len(toks)))
+
+        res = await pump.generate_streaming(req, on_tokens)
+        ttfts.append(res.ttft_s)
+        prev = None
+        for t, k in marks:
+            if prev is not None:
+                itls.append(t - prev)      # chunk gap: the consumer-visible
+                itls.extend([0.0] * (k - 1))   # intra-chunk tokens co-arrive
+            prev = t
+        return len(res.tokens)
+
+    async def run():
+        rs = np.random.RandomState(3)
+        tasks = []
+        t_start = time.perf_counter()
+        for req in reqs:
+            tasks.append(asyncio.create_task(client(req)))
+            await asyncio.sleep(float(rs.exponential(1.0 / rate)))
+        counts = await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t_start
+        await pump.stop()
+        return sum(counts), wall
+
+    total_toks, wall = asyncio.run(run())
+    m = engine.get_metrics()
+    pct = lambda xs, q: (sorted(xs)[min(len(xs) - 1,
+                                        math.ceil(q * len(xs)) - 1)]
+                         if xs else 0.0)
+    toks_per_s = total_toks / wall
+    ttft_p50, ttft_p99 = pct(ttfts, 0.5) * 1e3, pct(ttfts, 0.99) * 1e3
+    itl_p99 = pct(itls, 0.99) * 1e3
+    d_steps = m["engine_steps"] - steps0
+    occ = ((m["batch_occupancy"] * m["engine_steps"] * engine.max_slots
+            - occ_sum0) / (d_steps * engine.max_slots)) if d_steps else 0.0
+    log(f"served {len(reqs)} reqs ({total_toks} tokens) in {wall:.1f}s at "
+        f"offered rate {rate}/s -> {toks_per_s:.1f} tok/s; TTFT p50 "
+        f"{ttft_p50:.0f} ms p99 {ttft_p99:.0f} ms; ITL p99 {itl_p99:.1f} ms; "
+        f"occupancy {occ:.2f}")
+    print(json.dumps({
+        "metric": f"serving_throughput_{MODEL}{'_int8' if QUANT else ''}"
+                  f"_rate{rate:g}",
+        "value": round(toks_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_s / NORTH_STAR_TOKS, 2),
+        "ttft_p50_ms": round(ttft_p50, 1),
+        "ttft_p99_ms": round(ttft_p99, 1),
+        "itl_p99_ms": round(itl_p99, 2),
+        "occupancy": round(occ, 3),
+    }), flush=True)
 
 
 def main() -> None:
@@ -65,90 +314,12 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
     import jax
-    import numpy as np
 
-    from distributed_inference_engine_tpu.config import EngineConfig
-    from distributed_inference_engine_tpu.engine.engine import Engine
-    from distributed_inference_engine_tpu.engine.types import GenerationRequest
-    from distributed_inference_engine_tpu.models.gpt2 import gpt2_spec
-
-    devs = jax.devices()
-    log(f"devices: {devs}")
-
-    spec = gpt2_spec(MODEL)
-    # BENCH_ENGINE=continuous measures the serving engine (paged KV,
-    # batched admission) instead of the static batch engine.
-    engine_kind = os.environ.get("BENCH_ENGINE", "static")
-    # continuous default matches the static chunk: this benchmark submits
-    # every request up front, so shorter chunks only add sync round trips
-    # (serving deployments pick shorter chunks for admission latency)
-    steps = int(os.environ.get("BENCH_STEPS", str(NEW_TOKENS)))
-    cfg = EngineConfig(
-        max_slots=BATCH,
-        max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
-        prefill_buckets=[PROMPT_LEN],
-        decode_steps_per_call=steps,
-    )
-    t0 = time.perf_counter()
-    if engine_kind == "continuous":
-        from distributed_inference_engine_tpu.engine.continuous import (
-            ContinuousEngine,
-        )
-
-        cfg.page_size = 128
-        per_seq = -(-(PROMPT_LEN + NEW_TOKENS) // cfg.page_size)  # ceil
-        cfg.num_pages = max(64, BATCH * per_seq + 8)
-        engine = ContinuousEngine(spec, config=cfg)
+    log(f"devices: {jax.devices()}")
+    if ENGINE_KIND == "serving":
+        serving_main()
     else:
-        engine = Engine(spec, config=cfg)
-    log(f"engine init ({MODEL}, {engine_kind}): {time.perf_counter() - t0:.1f}s")
-
-    rs = np.random.RandomState(0)
-
-    def make_requests(seed: int):
-        rs2 = np.random.RandomState(seed)
-        return [
-            GenerationRequest(
-                prompt=rs2.randint(0, spec.vocab_size, size=PROMPT_LEN).tolist(),
-                max_new_tokens=NEW_TOKENS,
-                temperature=0.0,
-                request_id=f"bench-{seed}-{i}",
-            )
-            for i in range(BATCH)
-        ]
-
-    # warmup: compiles prefill + decode-chunk programs for the bucket shapes
-    t0 = time.perf_counter()
-    engine.generate(make_requests(1))
-    log(f"warmup (compile): {time.perf_counter() - t0:.1f}s")
-
-    # measured runs. Decode throughput = tokens after the first / decode
-    # wall (prefill+first-sample time excluded — it is reported as TTFT, and
-    # folding it in would dilute the steady-state number the metric names).
-    runs = int(os.environ.get("BENCH_RUNS", "3"))
-    best_toks = 0.0
-    ttfts = []
-    for r in range(runs):
-        t0 = time.perf_counter()
-        results = engine.generate(make_requests(100 + r))
-        wall = time.perf_counter() - t0
-        gen = sum(len(x.tokens) for x in results)
-        decode_s = results[0].decode_s
-        toks = (gen - len(results)) / decode_s    # first token is prefill's
-        ttfts.append(results[0].ttft_s)
-        log(f"run {r}: {gen} tokens, e2e {wall:.2f}s "
-            f"({gen / wall:.1f} tok/s e2e), decode {decode_s:.2f}s -> "
-            f"{toks:.1f} tok/s (ttft {results[0].ttft_s * 1e3:.1f} ms)")
-        best_toks = max(best_toks, toks)
-
-    ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1e3
-    log(f"p50 TTFT: {ttft_ms:.1f} ms")
-    print(json.dumps({
-        "metric": f"decode_throughput_{MODEL}_bs{BATCH}",
-        "value": round(best_toks, 1),
-        "unit": "tok/s",
-        "vs_baseline": round(best_toks / REFERENCE_SIM_CEILING_TOKS, 2),
-    }), flush=True)
+        decode_main()
 
 
 if __name__ == "__main__":

@@ -164,14 +164,16 @@ def engine_from_config(cfg):
         # made real — halves decode's HBM weight traffic
         import jax as _jax
 
-        from ..ops.quant import quantize_params
+        from ..ops.quant import quantize_params, random_quantized_params
 
         if params is None:
-            from .base import init_params
-
-            params = init_params(spec, _jax.random.key(
+            # direct int8 init: init-then-quantize would peak at the full
+            # bf16 tree + f32 working copies — OOM at exactly the 8B-on-
+            # one-chip deploys the quantized flag exists for
+            params = random_quantized_params(spec, _jax.random.key(
                 int(cfg.metadata.get("seed", 0))))
-        params = quantize_params(spec, params)
+        else:
+            params = quantize_params(spec, params)
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",

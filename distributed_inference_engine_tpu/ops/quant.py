@@ -138,6 +138,65 @@ def quantize_params(spec, params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def random_quantized_params(spec, key, w_std: float = 0.02) -> Dict[str, Any]:
+    """int8 param tree initialized DIRECTLY — no full-precision source.
+
+    Random-init quantized serving at 8B scale cannot init-then-quantize:
+    the bf16 tree plus the per-leaf f32 working copy peaks well above the
+    model's own HBM footprint on exactly the single-chip int8 deploys
+    quantization exists for (16 GB v5e, BASELINE.md rung 3). Here every
+    quantizable weight is born int8 (uniform random payload, constant
+    per-channel scale ``w_std/127`` ⇒ effective weight std ≈ ``w_std``,
+    matching ``init_params``); norms init to ones, biases to zeros, and
+    full-precision leaves (embeddings, router) to scaled normals. FLOP
+    and byte counts are identical to a quantized real checkpoint, which
+    is all random-init serving is for.
+    """
+    import itertools
+
+    from ..models.base import init_params
+
+    abstract = jax.eval_shape(lambda: init_params(spec, jax.random.key(0)))
+    moe = bool(getattr(spec, "n_experts", 0))
+    counter = itertools.count()
+    nk = lambda: jax.random.fold_in(key, next(counter))
+
+    def q_leaf(leaf, axes):
+        q = jax.random.randint(nk(), leaf.shape, -127, 128, dtype=jnp.int8)
+        s_shape = tuple(1 if i in axes else d
+                        for i, d in enumerate(leaf.shape))
+        return QuantizedTensor(
+            q=q, s=jnp.full(s_shape, w_std / 127.0, jnp.float32))
+
+    def f_leaf(name, leaf):
+        if "scale" in name:
+            return jnp.ones(leaf.shape, leaf.dtype)
+        # biases: ln*_bias plus the projection biases named bq/bk/bv/bo/
+        # b_up/b_down in init_params
+        if "bias" in name or name.startswith("b"):
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        return (jax.random.normal(nk(), leaf.shape, jnp.float32)
+                * w_std).astype(leaf.dtype)
+
+    blocks: Dict[str, Any] = {}
+    for name, leaf in abstract["blocks"].items():
+        if name in _BLOCK_WEIGHTS:
+            axes = (_MOE_WEIGHTS[name] if moe and name in _MOE_WEIGHTS
+                    else _BLOCK_WEIGHTS[name])
+            blocks[name] = q_leaf(leaf, tuple(a + 1 for a in axes))
+        else:
+            blocks[name] = f_leaf(name, leaf)
+    out: Dict[str, Any] = {}
+    for name, leaf in abstract.items():
+        if name == "blocks":
+            out[name] = blocks
+        elif name == "lm_head" and not spec.tie_embeddings:
+            out[name] = q_leaf(leaf, (0,))
+        else:
+            out[name] = f_leaf(name, leaf)
+    return out
+
+
 def param_bytes(params: Any) -> int:
     """Total stored bytes of a (possibly quantized) param tree."""
     total = 0
