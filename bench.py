@@ -167,7 +167,11 @@ def _requests(spec, seed: int, n: int):
 def decode_main() -> None:
     """Batch-decode throughput rung (static or continuous engine)."""
     spec = _spec()
-    steps = int(os.environ.get("BENCH_STEPS", str(NEW_TOKENS)))
+    # continuous default chunk 64: side-window churn grows with the chunk,
+    # per-chunk sync/merge amortizes with it — 64 measured best at 8B bs64
+    # (2716 tok/s vs 2524 at 128 / 2559 at 32)
+    default_steps = 64 if ENGINE_KIND == "continuous" else NEW_TOKENS
+    steps = int(os.environ.get("BENCH_STEPS", str(default_steps)))
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
     engine = _engine(spec, params, ENGINE_KIND, BATCH, steps)

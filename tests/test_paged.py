@@ -223,3 +223,76 @@ def test_prefill_page_scatter_roundtrip():
             )
     # padded tail of slot 1 (positions 13..19) must NOT have been written
     np.testing.assert_allclose(kp_np[:, table[1, 0], 14], 0.0, atol=0)
+
+
+# -------------------------------------------- flash stats + stacked pools
+
+
+def test_stats_merge_matches_single_softmax():
+    """Splitting the key set into paged-prefix + side-window partials and
+    merging their flash stats must equal one softmax over the union —
+    the invariant the windowed decode chunk rests on."""
+    from distributed_inference_engine_tpu.ops.attention import (
+        merge_attention, window_decode_attention,
+    )
+
+    q, kp, vp, table, _ = _random_paged_case(5)
+    lengths = jnp.asarray([30, 17, 64], jnp.int32)
+    rs = np.random.RandomState(9)
+    b, h = q.shape[0], q.shape[1]
+    W, n_kv, dh = 8, 2, q.shape[2]
+    ks = jnp.asarray(rs.randn(b, W, n_kv, dh), jnp.float32)
+    vs = jnp.asarray(rs.randn(b, W, n_kv, dh), jnp.float32)
+    n_side = jnp.asarray([3, 0, 8], jnp.int32)   # incl. a zero-valid row
+
+    prefix = paged_attention_xla(q, kp, vp, table, lengths, n_kv_heads=2,
+                                 with_stats=True)
+    window = window_decode_attention(q, ks, vs, n_side)
+    merged = merge_attention([prefix, window])
+
+    # reference: one dense softmax over gathered prefix + valid side keys
+    mp, p = table.shape[1], kp.shape[1]
+    k_all = kp[table].reshape(b, mp * p, n_kv, dh)
+    v_all = vp[table].reshape(b, mp * p, n_kv, dh)
+    k_cat = jnp.concatenate([k_all, ks], axis=1)
+    v_cat = jnp.concatenate([v_all, vs], axis=1)
+    s_tot = mp * p + W
+    valid = (jnp.arange(s_tot)[None, :] < lengths[:, None]) | (
+        (jnp.arange(s_tot)[None, :] >= mp * p)
+        & (jnp.arange(s_tot)[None, :] - mp * p < n_side[:, None]))
+    qg = q.reshape(b, n_kv, h // n_kv, dh)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cat) / np.sqrt(dh)
+    scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ref = jnp.einsum("bkgs,bskd->bkgd", probs, v_cat).reshape(b, h, dh)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_stats_and_stacked_layer_match_xla():
+    """Kernel feature parity in interpret mode: with_stats returns the
+    same (m, l) the XLA path computes, and stacked-pool layer indexing
+    reads layer l's pages exactly."""
+    q, kp, vp, table, lengths = _random_paged_case(11)
+    ref_out, ref_m, ref_l = paged_attention_xla(
+        q, kp, vp, table, lengths, n_kv_heads=2, with_stats=True)
+    out, m, l = paged_attention_pallas(
+        q, kp, vp, table, lengths, n_kv_heads=2, interpret=True,
+        with_stats=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(ref_m), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(ref_l), rtol=1e-5)
+
+    # stacked pools: layer 1 of a 3-layer stack
+    L, n = 3, kp.shape[0]
+    rs = np.random.RandomState(2)
+    big_k = jnp.asarray(rs.randn(L * n, *kp.shape[1:]), kp.dtype)
+    big_v = jnp.asarray(rs.randn(L * n, *vp.shape[1:]), vp.dtype)
+    ref2 = paged_attention_xla(q, big_k[n:2 * n], big_v[n:2 * n], table,
+                               lengths, n_kv_heads=2)
+    out2 = paged_attention_pallas(
+        q, big_k, big_v, table, lengths, n_kv_heads=2, interpret=True,
+        layer=jnp.asarray(1), n_pages_per_layer=n)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                               rtol=2e-5, atol=2e-5)
