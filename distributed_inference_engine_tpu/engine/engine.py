@@ -100,7 +100,10 @@ class Engine:
         shard_fn=None,   # optional: fn(params) -> sharded params (parallel/)
         sp_mesh=None,    # optional: mesh with a real sp axis — long prompts
                          # prefill sequence-parallel via ring attention
-                         # (parallel/long_context.py); decode is unchanged
+                         # (parallel/long_context.py) AND decode runs
+                         # context-parallel against a sequence-sharded KV
+                         # cache (greedy near-ties may resolve differently
+                         # than unsharded: reordered fp reductions)
     ) -> None:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
@@ -110,6 +113,20 @@ class Engine:
             params = shard_fn(params)
         self.params = params
         self._rng = jax.random.key(seed + 1)
+
+        # context-parallel decode: with an sp mesh the dense KV cache is
+        # PLACED sequence-sharded (parallel.sharding.kv_cache_pspec) and
+        # stays that way through the decode scan — each chip holds and
+        # reads 1/sp of the cache; GSPMD inserts the softmax/contraction
+        # all-reduces. Applied per batch when the bucket dims divide the
+        # axes (see generate()).
+        self._cache_sharding = None
+        if sp_mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.sharding import kv_cache_pspec
+
+            self._cache_sharding = NamedSharding(sp_mesh, kv_cache_pspec())
 
         cfg = self.config
         self.batch_buckets = _pow2_buckets(cfg.max_slots)
@@ -250,11 +267,22 @@ class Engine:
             sampling, k0,
         )
 
-        # ---- seed decode state; KV cache sized to the total-seq bucket
+        # ---- seed decode state; KV cache sized to the total-seq bucket.
+        # With an sp mesh the cache is born sequence-sharded (decode then
+        # runs context-parallel); small buckets that don't divide the mesh
+        # axes fall back to the default placement
         L, Hkv, Dh = self.spec.n_layers, self.spec.n_kv_heads, self.spec.head_dim
         dt = jnp.dtype(self.config.kv_dtype)
-        ck = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt)
-        cv = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt)
+        dev = {}
+        if self._cache_sharding is not None:
+            from ..parallel.sharding import compatible_sharding
+
+            # per-axis fallback: bb=1 can't split over dp, but that must
+            # not cost the sequence split
+            dev = {"device": compatible_sharding(
+                self._cache_sharding, (L, bb, total_cap, Hkv, Dh))}
+        ck = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt, **dev)
+        cv = jnp.zeros((L, bb, total_cap, Hkv, Dh), dtype=dt, **dev)
         ck = ck.at[:, :, :tb].set(ks.astype(dt))
         cv = cv.at[:, :, :tb].set(vs.astype(dt))
 

@@ -349,9 +349,15 @@ class SpeculativeEngine:
                    self.spec.head_dim)
         shape_d = (L_d, bb, total_cap, self.draft_spec.n_kv_heads,
                    self.draft_spec.head_dim)
-        # target caches follow the tp/kv sharding; draft caches replicate
-        # with their (replicated) params
-        tdev = {"device": self._kv_sharding} if self._kv_sharding else {}
+        # target caches follow the tp/kv sharding (with per-axis fallback
+        # for bucket dims that don't divide the mesh); draft caches
+        # replicate with their (replicated) params
+        tdev = {}
+        if self._kv_sharding is not None:
+            from ..parallel.sharding import compatible_sharding
+
+            tdev = {"device": compatible_sharding(self._kv_sharding,
+                                                  shape_t)}
         ddev = {"device": self._rep_sharding} if self._rep_sharding else {}
         tck = jnp.zeros(shape_t, dt, **tdev).at[:, :, :tb].set(tks.astype(dt))
         tcv = jnp.zeros(shape_t, dt, **tdev).at[:, :, :tb].set(tvs.astype(dt))

@@ -15,8 +15,10 @@ activation constraints, let XLA insert the collectives):
 - MLP: up/gate column-sharded, down row-sharded (one psum per block);
 - embeddings/LM head: vocab-sharded over ``tp`` (logits all-gather at the
   end — once per step, off the per-layer critical path);
-- KV cache: ``n_kv_heads`` over ``tp``, slots over ``dp`` — each chip holds
-  only its heads' cache, so HBM per chip drops linearly with tp;
+- KV cache: ``n_kv_heads`` over ``tp``, slots over ``dp``, SEQUENCE over
+  ``sp`` — each chip holds only its heads' share of its sequence shard, so
+  per-chip KV HBM drops with tp·sp and decode runs context-parallel
+  (GSPMD lowers the sharded-S softmax/contraction to all-reduces);
 - norms: replicated (tiny).
 
 ``ep`` is reserved for MoE expert sharding; ``pp`` for stage-split layers
@@ -87,8 +89,15 @@ def param_pspecs(spec: ModelSpec) -> Dict[str, Any]:
 
 
 def kv_cache_pspec() -> P:
-    """[L, B, S, Hkv, Dh]: slots over dp, kv heads over tp."""
-    return P(None, "dp", None, "tp", None)
+    """[L, B, S, Hkv, Dh]: slots over dp, SEQUENCE over sp, kv heads over
+    tp. The sp split makes decode context-parallel: per-chip attention
+    covers its sequence shard and GSPMD lowers the softmax max/sum and the
+    probs·V contraction over S to local work + all-reduces — long-context
+    decode HBM and reads scale 1/sp per chip with no hand-written
+    collectives (the ring/blockwise alternative only pays off once the
+    per-step all-reduce latency beats 1/sp of the cache read, i.e. far
+    beyond single-host contexts)."""
+    return P(None, "dp", "sp", "tp", None)
 
 
 def paged_kv_pspec() -> P:
@@ -134,6 +143,29 @@ class ModelShardings:
     def shard_fn(self):
         """A ``params -> sharded params`` function for ``Engine(shard_fn=…)``."""
         return lambda params: shard_params(params, self)
+
+
+def compatible_sharding(base: NamedSharding, shape) -> NamedSharding:
+    """``base`` with every axis whose mesh size doesn't divide its dim
+    DROPPED (replicated) — a per-axis fallback for runtime-shaped arrays.
+
+    The engines size KV caches per batch (batch bucket, padded seq cap);
+    a single-request batch (bb=1) can't shard over dp=2, but that must
+    not cost the sequence split — the 1/sp per-chip HBM scaling is the
+    point of context-parallel decode. All-or-nothing fallback would.
+    """
+    spec = list(base.spec) + [None] * (len(shape) - len(base.spec))
+    new = []
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            new.append(None)
+            continue
+        names = (axes,) if isinstance(axes, str) else axes
+        size = 1
+        for nm in names:
+            size *= base.mesh.shape[nm]
+        new.append(axes if size and dim % size == 0 else None)
+    return NamedSharding(base.mesh, P(*new))
 
 
 def scale_sharding(scale_shape, weight_sharding: NamedSharding) -> NamedSharding:

@@ -139,8 +139,9 @@ def test_train_step_runs_sharded_and_loss_decreases():
 def test_kv_cache_sharding_spec_shape():
     from distributed_inference_engine_tpu.parallel.sharding import kv_cache_pspec
 
+    # sequence over sp: the dense cache decodes context-parallel (r2)
     spec = kv_cache_pspec()
-    assert spec == jax.sharding.PartitionSpec(None, "dp", None, "tp", None)
+    assert spec == jax.sharding.PartitionSpec(None, "dp", "sp", "tp", None)
 
 
 def test_tp_engine_generate_matches_unsharded():
