@@ -44,7 +44,6 @@ from ..models.base import (
     Params,
     forward_decode_paged,
     forward_decode_window,
-    forward_prefill,
     forward_prefill_suffix,
     init_params,
     unembed,
@@ -130,6 +129,11 @@ class ContinuousEngine:
         shard_fn=None,
         kv_sharding=None,   # NamedSharding for the page pools (tp serving;
                             # parallel.sharding.ModelShardings.paged_kv)
+        sp_mesh=None,       # optional mesh with a real sp axis: ADMISSION
+                            # prefill runs sequence-parallel ring attention
+                            # (long prompts stall decode 1/sp as long, the
+                            # same concern prefill_chunk addresses in time
+                            # rather than space — the two are exclusive)
     ) -> None:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
@@ -205,10 +209,29 @@ class ContinuousEngine:
 
         # ---- jitted programs
         spec_ = self.spec
+        has_sp = (sp_mesh is not None
+                  and sp_mesh.shape.get("sp", 1) > 1)
+        if has_sp and self._chunk:
+            raise ValueError(
+                "prefill_chunk and sp compose poorly: both bound the "
+                "decode stall from long-prompt admission (chunking in "
+                "time, sp in space), and the suffix-chunk programs are "
+                "not sequence-parallel — pick one")
+        if has_sp and shard_fn is not None:
+            from .engine import _check_same_mesh
+
+            # fail the deploy, not the first admission trace
+            _check_same_mesh(self.params, sp_mesh)
+        from ..parallel.long_context import prefill_fn_for
+
+        # sp: admission prefill swaps in ring attention; the suffix path
+        # (prefix-cache hits) stays dense — cached tails are bounded by
+        # the prompt the prefix cache already covered
+        fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 
         @jax.jit
         def _prefill(params, tokens, seq_lens, sampling, key):
-            hidden, ks, vs = forward_prefill(spec_, params, tokens, seq_lens)
+            hidden, ks, vs = fwd_prefill(spec_, params, tokens, seq_lens)
             last = hidden[jnp.arange(tokens.shape[0]), seq_lens - 1]
             logits = unembed(spec_, params, last)
             # sampled in-program: eager sampling is a dispatch chain that

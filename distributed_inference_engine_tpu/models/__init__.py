@@ -243,12 +243,8 @@ def engine_from_config(cfg):
     if cfg.metadata.get("continuous"):
         from ..engine.continuous import ContinuousEngine
 
-        if sp_mesh is not None:
-            raise ValueError(
-                "sp metadata is for prefill-phase engines (static, or "
-                "role=prefill); the continuous engine prefills densely — "
-                "use tp (and a disaggregated sp prefill pool) instead")
         return ContinuousEngine(spec, params=params, config=ecfg,
-                                shard_fn=shard_fn, kv_sharding=kv_sharding)
+                                shard_fn=shard_fn, kv_sharding=kv_sharding,
+                                sp_mesh=sp_mesh)
     return Engine(spec, params=params, config=ecfg, shard_fn=shard_fn,
                   sp_mesh=sp_mesh)

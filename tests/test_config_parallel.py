@@ -49,9 +49,26 @@ def test_sp_prefill_pool_from_config():
     assert h.prompt_len == 39 and h.k.shape[1] == 39
 
 
-def test_continuous_plus_sp_rejected():
-    with pytest.raises(ValueError, match="prefill-phase"):
-        engine_from_config(_cfg(continuous=1, sp=4))
+def test_continuous_sp_prefill_matches_unsharded():
+    """sp composes with the continuous engine (the last round-1 rejection
+    closed): admission prefill runs sequence-parallel ring attention, the
+    paged decode is unchanged — token parity with the unsharded engine."""
+    plain = engine_from_config(_cfg(continuous=1, page_size=16,
+                                    prefill_buckets=[64]))
+    sp = engine_from_config(_cfg(continuous=1, page_size=16, sp=4, dp=2,
+                                 prefill_buckets=[64]))
+    req = lambda: GenerationRequest(prompt=list(range(1, 50)),
+                                    max_new_tokens=8)
+    assert sp.generate([req()])[0].tokens == plain.generate([req()])[0].tokens
+
+
+def test_continuous_sp_plus_chunking_rejected():
+    """prefill_chunk and sp both bound the admission stall; the suffix
+    chunk programs are not sequence-parallel — explicit error, not silent
+    wrong sharding."""
+    with pytest.raises(ValueError, match="pick one"):
+        engine_from_config(_cfg(continuous=1, sp=4, prefill_chunk=32,
+                                prefill_buckets=[64]))
 
 
 def _qcfg(**meta):

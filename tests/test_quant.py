@@ -125,3 +125,21 @@ def test_quantized_moe_exact_path_runs():
     assert np.isfinite(quant).all()
     agree = (full.argmax(-1) == quant.argmax(-1)).mean()
     assert agree > 0.8, f"top-1 agreement {agree:.2f}"
+
+
+def test_quantized_speculative_composes():
+    """int8 target + full-precision draft through the config path: the
+    registry's quantized flag and speculative metadata must compose (the
+    target's QuantizedTensor tree flows through forward_window via
+    matmul_any)."""
+    cfg = ModelConfig(
+        name="qs", architecture="llama", dtype="float32", quantized=True,
+        max_seq_len=64, max_batch_size=2,
+        metadata={"size": "llama-tiny", "speculative": 2,
+                  "draft_size": "llama-tiny"},
+    )
+    eng = engine_from_config(cfg)
+    assert isinstance(eng.params["blocks"]["wq"], QuantizedTensor)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3],
+                                          max_new_tokens=6)])[0]
+    assert len(out.tokens) == 6
