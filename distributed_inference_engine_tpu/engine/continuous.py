@@ -217,16 +217,24 @@ class ContinuousEngine:
                 "decode stall from long-prompt admission (chunking in "
                 "time, sp in space), and the suffix-chunk programs are "
                 "not sequence-parallel — pick one")
-        if has_sp and shard_fn is not None:
+        if has_sp:
             from .engine import _check_same_mesh
 
-            # fail the deploy, not the first admission trace
+            # fail the deploy, not the first admission trace (no-op when
+            # params carry no mesh — covers pre-sharded params too)
             _check_same_mesh(self.params, sp_mesh)
+            if self.prefix_cache:
+                # a cache hit prefills its UNIQUE suffix through the dense
+                # suffix program — an arbitrarily long tail would stall
+                # decode unbounded, the very thing sp exists to bound, so
+                # an sp deploy prefers whole-prompt ring prefill over
+                # prefix reuse until a sequence-parallel suffix program
+                # exists
+                logger.info("sp prefill disables the prefix cache "
+                            "(dense suffix program; see ContinuousEngine)")
+                self.prefix_cache = False
         from ..parallel.long_context import prefill_fn_for
 
-        # sp: admission prefill swaps in ring attention; the suffix path
-        # (prefix-cache hits) stays dense — cached tails are bounded by
-        # the prompt the prefix cache already covered
         fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 
         @jax.jit

@@ -152,7 +152,9 @@ class PrefillEngine:
         from ..parallel.long_context import prefill_fn_for
         from .engine import _check_same_mesh
 
-        if sp_mesh is not None and shard_fn is not None:
+        if sp_mesh is not None:
+            # no-op when params carry no mesh — covers pre-sharded
+            # params passed without a shard_fn too
             _check_same_mesh(self.params, sp_mesh)
         fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 

@@ -141,7 +141,9 @@ class Engine:
         spec_ = self.spec
         from ..parallel.long_context import prefill_fn_for
 
-        if sp_mesh is not None and shard_fn is not None:
+        if sp_mesh is not None:
+            # no-op when params carry no mesh — covers pre-sharded
+            # params passed without a shard_fn too
             _check_same_mesh(self.params, sp_mesh)
         fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 

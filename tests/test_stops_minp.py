@@ -117,16 +117,21 @@ def test_min_p_restricts_support():
     logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.05, 0.02, 0.02,
                                    0.005, 0.005]], jnp.float32))
     params = SamplingParams.make(1, temperature=1.0, min_p=0.6)
+
+    # one jitted vmap over keys: 300+ eager sample calls took 40+ s of
+    # pure dispatch on this box
+    @jax.jit
+    def draws(p, keys):
+        return jax.vmap(lambda k: sample_tokens(logits, p, k)[0])(keys)
+
     # p >= 0.6 * 0.4 = 0.24 -> only tokens 0 and 1 survive
-    seen = set()
-    for i in range(64):
-        tok = int(sample_tokens(logits, params, jax.random.key(i))[0])
-        seen.add(tok)
+    seen = set(np.asarray(
+        draws(params, jax.random.split(jax.random.key(0), 64))).tolist())
     assert seen <= {0, 1} and len(seen) == 2
     # min_p=0 leaves the tail reachable
     params0 = SamplingParams.make(1, temperature=1.0, min_p=0.0)
-    seen0 = {int(sample_tokens(logits, params0, jax.random.key(i))[0])
-             for i in range(256)}
+    seen0 = set(np.asarray(
+        draws(params0, jax.random.split(jax.random.key(1), 256))).tolist())
     assert len(seen0) > 2
 
 
