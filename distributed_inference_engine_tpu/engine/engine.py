@@ -61,16 +61,18 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
 
 
 def _check_same_mesh(params, sp_mesh) -> None:
-    """shard_fn + sp_mesh must agree on the mesh: params placed on one
-    mesh with activations constrained to another makes XLA reshard the
-    whole model across device orderings inside every prefill."""
+    """The params' placement and sp_mesh must agree: params on one mesh
+    with activations constrained to another makes XLA reshard the whole
+    model across device orderings inside every prefill. Covers both
+    construction paths — a shard_fn and pre-sharded params passed
+    directly; no-op when params carry no mesh."""
     leaf = jax.tree.leaves(params)[0]
     mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
     if mesh is not None and mesh != sp_mesh:
         raise ValueError(
-            "shard_fn placed params on a different mesh than sp_mesh — "
-            "cross-mesh prefill would reshard params every dispatch; "
-            "build both from the same Mesh")
+            "params are placed on a different mesh than sp_mesh (via "
+            "shard_fn or pre-sharded) — cross-mesh prefill would reshard "
+            "params every dispatch; build both from the same Mesh")
 
 
 def _pow2_buckets(cap: int, start: int = 1) -> List[int]:

@@ -171,6 +171,58 @@ def demo_warmup() -> None:
           f"first real request {t_req*1e3:.0f}ms")
 
 
+def demo_round2_compositions() -> None:
+    banner("Round 2: int8 x tp, speculative knobs, sp decode, persistence")
+    # int8 weight-only composed with tensor parallelism via plain config
+    cfg = ModelConfig(name="q8", architecture="llama-tiny", dtype="float32",
+                      max_batch_size=2, max_seq_len=128,
+                      metadata={"continuous": 1, "page_size": 16, "tp": 2})
+    cfg.quantized = True
+    eng = engine_from_config(cfg)
+    out = eng.generate([GenerationRequest(prompt=[1, 2, 3, 4],
+                                          max_new_tokens=6)])[0]
+    print(f"  int8 tp=2 continuous serve: {out.tokens} "
+          f"(wq sharding {eng.params['blocks']['wq'].q.sharding.spec})")
+
+    # speculative decoding honoring top-k (one-hot => target's exact chain)
+    sp_cfg = ModelConfig(name="s", architecture="llama-tiny",
+                         dtype="float32", max_batch_size=2, max_seq_len=64,
+                         metadata={"speculative": 2,
+                                   "draft_size": "llama-tiny"})
+    sp_eng = engine_from_config(sp_cfg)
+    out = sp_eng.generate([GenerationRequest(prompt=[5, 6, 7],
+                                             max_new_tokens=6,
+                                             temperature=0.8, top_k=1)])[0]
+    m = sp_eng.get_metrics()
+    print(f"  speculative top_k=1 @ temp 0.8: {out.tokens} "
+          f"(acceptance {m['draft_acceptance_rate']:.2f})")
+
+    # context-parallel decode: sequence-sharded dense KV cache
+    cp = engine_from_config(ModelConfig(
+        name="cp", architecture="llama-tiny", dtype="float32",
+        max_batch_size=2, max_seq_len=128,
+        metadata={"sp": 4, "dp": 2, "prefill_buckets": [64]}))
+    out = cp.generate([GenerationRequest(prompt=list(range(1, 50)),
+                                         max_new_tokens=6)])[0]
+    print(f"  sp=4 decode (cache spec {cp._cache_sharding.spec}): "
+          f"{out.tokens}")
+
+    # response-cache persistence round-trip
+    import tempfile
+
+    from distributed_inference_engine_tpu.serving.cache import ResponseCache
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "cache.pkl")
+        c = ResponseCache(max_size=8)
+        c.set(("m", (1, 2, 3)), {"tokens": [9, 8]}, ttl=60.0)
+        c.save(path)
+        c2 = ResponseCache(max_size=8)
+        c2.load(path)
+        print(f"  cache persisted + restored: {c2.get(('m', (1, 2, 3)))} "
+              f"(remaining ttl {c2._entries[('m', (1, 2, 3))].ttl:.0f}s)")
+
+
 def main() -> None:
     if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
         sys.exit(
@@ -185,6 +237,7 @@ def main() -> None:
     demo_config_parallel()
     demo_pipeline()
     demo_warmup()
+    demo_round2_compositions()
     print("\nAll capability demos completed.")
 
 
