@@ -24,6 +24,8 @@ Env knobs:
     BENCH_BATCH    decode slots (default 64 — the throughput-serving point)
     BENCH_PROMPT / BENCH_NEW_TOKENS   lengths (default 128 / 128)
     BENCH_KV_DTYPE paged-KV dtype (continuous; default bfloat16)
+    BENCH_DECODE_MODE  window | inline (default: window for 8B-class,
+                   inline for small-KV models — the measured crossover)
     serving mode:  BENCH_RATE (req/s Poisson, default 16),
                    BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16)
 """
@@ -114,6 +116,13 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
     cfg.page_size = 128
     per_seq = -(-(PROMPT_LEN + NEW_TOKENS) // cfg.page_size)  # ceil
     cfg.num_pages = max(64, batch * per_seq + 8)
+    # measured crossover (README table): windowed chunks win when weight
+    # streaming dominates (8B: 2658 vs 1038 tok/s); small-KV models keep
+    # the inline per-step scatter (GPT-2: 10673 vs 7169)
+    if os.environ.get("BENCH_DECODE_MODE"):
+        cfg.decode_mode = os.environ["BENCH_DECODE_MODE"]
+    elif not IS_BIG:
+        cfg.decode_mode = "inline"
     return ContinuousEngine(spec, params=params, config=cfg)
 
 

@@ -138,6 +138,11 @@ class ContinuousEngine:
         self.spec = spec.validate()
         self.config = config or EngineConfig()
         cfg = self.config
+        if cfg.decode_mode not in ("window", "inline"):
+            # before param init: a typo'd mode must not pay an 8B-scale
+            # random init first
+            raise ValueError(
+                f"decode_mode {cfg.decode_mode!r} is not 'window'|'inline'")
         if params is None:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
@@ -286,9 +291,11 @@ class ContinuousEngine:
         # a dense side buffer, merged into pages ONCE per chunk — the
         # per-step page scatter it replaces held decode at ~28% of the
         # dense engine's throughput at 8B bs64 (see forward_decode_window).
-        # Sliding-window specs keep the per-step path (their prefix mask
-        # depends on the growing total length).
-        use_window = not spec_.sliding_window
+        # Small-KV models (GPT-2-class) measure faster with the inline
+        # scatter (decode_mode="inline"); sliding-window specs always run
+        # inline (their prefix mask depends on the growing total length).
+        use_window = (cfg.decode_mode == "window"
+                      and not spec_.sliding_window)
 
         @partial(jax.jit, static_argnames=("n_steps",),
                  donate_argnums=(1, 2, 3, 4, 5, 6))

@@ -178,7 +178,7 @@ def engine_from_config(cfg):
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",
               "attention_impl", "kv_dtype", "prefill_buckets",
-              "prefix_cache", "prefill_chunk"):
+              "prefix_cache", "prefill_chunk", "decode_mode"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

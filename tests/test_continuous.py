@@ -179,3 +179,29 @@ def test_serving_metrics_ttft_and_occupancy():
     # at least the first wave's decode time (strictly > admission-only)
     ttfts = sorted(r.ttft_s for r in out)
     assert ttfts[-1] > ttfts[0]
+
+
+def test_decode_mode_inline_matches_window():
+    """decode_mode='inline' (per-step KV scatter — measured faster for
+    small-KV models) and the default windowed chunks are the same math:
+    token-identical greedy output."""
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+
+    spec = llama_spec("llama-tiny", max_seq_len=128).replace(dtype="float32")
+    base = dict(max_slots=4, max_seq_len=128, prefill_buckets=[16, 64],
+                page_size=16, num_pages=48, decode_steps_per_call=4)
+    win = ContinuousEngine(spec, config=EngineConfig(**base), seed=0)
+    inline = ContinuousEngine(spec, params=win.params,
+                              config=EngineConfig(decode_mode="inline",
+                                                  **base))
+    reqs = lambda: [GenerationRequest(prompt=[1 + i, 5, 9], request_id=f"r{i}",
+                                      max_new_tokens=10) for i in range(3)]
+    a = {r.request_id: r.tokens for r in win.generate(reqs())}
+    b = {r.request_id: r.tokens for r in inline.generate(reqs())}
+    assert a == b
+
+    import pytest
+
+    with pytest.raises(ValueError, match="decode_mode"):
+        ContinuousEngine(spec, config=EngineConfig(decode_mode="bogus",
+                                                   **base))
