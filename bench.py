@@ -27,7 +27,9 @@ Env knobs:
     BENCH_DECODE_MODE  window | inline (default: window for 8B-class,
                    inline for small-KV models — the measured crossover)
     serving mode:  BENCH_RATE (req/s Poisson, default 16),
-                   BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16)
+                   BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16),
+                   BENCH_MAX_WAITING (queue cap, default 4x slots; 0 = off),
+                   BENCH_DEADLINE_S (queue deadline shed, default 10; 0 = off)
 """
 
 import json
@@ -178,10 +180,14 @@ def _requests(spec, seed: int, n: int):
 def decode_main() -> None:
     """Batch-decode throughput rung (static or continuous engine)."""
     spec = _spec()
-    # continuous default chunk 64: side-window churn grows with the chunk,
-    # per-chunk sync/merge amortizes with it — 64 measured best at 8B bs64
-    # (2716 tok/s vs 2524 at 128 / 2559 at 32)
-    default_steps = 64 if ENGINE_KIND == "continuous" else NEW_TOKENS
+    # continuous default chunk 128 (= NEW_TOKENS): with the round-3 dense-
+    # ctx chunk scheme the whole decode runs as ONE chunk — one ctx gather,
+    # one host sync — measuring 3623 tok/s at 8B bs64 vs 3173 at chunk 64
+    # (each extra chunk pays a tunnel round trip + a re-gather; the round-2
+    # side-window scheme peaked at chunk 64 because its side buffer grew
+    # with the chunk). Serving keeps small chunks (admission cadence).
+    default_steps = (min(128, NEW_TOKENS) if ENGINE_KIND == "continuous"
+                     else NEW_TOKENS)
     steps = int(os.environ.get("BENCH_STEPS", str(default_steps)))
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
@@ -236,6 +242,10 @@ def serving_main() -> None:
 
     from distributed_inference_engine_tpu.serving.pump import EnginePump
 
+    from distributed_inference_engine_tpu.engine.types import (
+        EngineOverloadedError,
+    )
+
     spec = _spec()
     # default offered load ~near capacity: an 8B chip serves ~4 requests/s
     # of 128 fresh tokens; small models far more
@@ -246,6 +256,13 @@ def serving_main() -> None:
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
     engine = _engine(spec, params, "continuous", BATCH, steps)
+    # overload handling on by default in serving mode: past saturation the
+    # engine sheds (typed error) instead of growing an unbounded queue, so
+    # the latency curve has a knee instead of a cliff (VERDICT r2 item 2)
+    engine.config.max_waiting = int(
+        os.environ.get("BENCH_MAX_WAITING", str(4 * BATCH)))
+    engine.config.queue_deadline_s = float(
+        os.environ.get("BENCH_DEADLINE_S", "10"))
     log(f"engine init ({MODEL}, serving, int8={QUANT}): "
         f"{time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()
@@ -264,13 +281,19 @@ def serving_main() -> None:
     steps0 = m0["engine_steps"]
     occ_sum0 = m0["batch_occupancy"] * steps0 * engine.max_slots
 
+    rejected = [0]                     # queue-full + deadline sheds
+
     async def client(req):
         marks = []
 
         def on_tokens(toks):
             marks.append((time.perf_counter(), len(toks)))
 
-        res = await pump.generate_streaming(req, on_tokens)
+        try:
+            res = await pump.generate_streaming(req, on_tokens)
+        except EngineOverloadedError:
+            rejected[0] += 1
+            return 0
         ttfts.append(res.ttft_s)
         prev = None
         for t, k in marks:
@@ -303,8 +326,10 @@ def serving_main() -> None:
     d_steps = m["engine_steps"] - steps0
     occ = ((m["batch_occupancy"] * m["engine_steps"] * engine.max_slots
             - occ_sum0) / (d_steps * engine.max_slots)) if d_steps else 0.0
+    rej_rate = rejected[0] / len(reqs) if reqs else 0.0
     log(f"served {len(reqs)} reqs ({total_toks} tokens) in {wall:.1f}s at "
-        f"offered rate {rate}/s -> {toks_per_s:.1f} tok/s; TTFT p50 "
+        f"offered rate {rate}/s -> {toks_per_s:.1f} tok/s goodput; "
+        f"rejected {rejected[0]} ({rej_rate:.0%}); TTFT p50 "
         f"{ttft_p50:.0f} ms p99 {ttft_p99:.0f} ms; ITL p99 {itl_p99:.1f} ms; "
         f"occupancy {occ:.2f}")
     print(json.dumps({
@@ -317,6 +342,8 @@ def serving_main() -> None:
         "ttft_p99_ms": round(ttft_p99, 1),
         "itl_p99_ms": round(itl_p99, 2),
         "occupancy": round(occ, 3),
+        "rejected": rejected[0],
+        "rejection_rate": round(rej_rate, 3),
     }), flush=True)
 
 

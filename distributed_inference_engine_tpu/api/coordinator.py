@@ -106,9 +106,13 @@ class Coordinator:
 
             if os.path.exists(persist):
                 # best-effort: a stale/corrupt snapshot must not block
-                # startup — the cache is an optimization, not state of record
+                # startup — the cache is an optimization, not state of
+                # record. persist_allow_pickle migrates pre-r3 pickle
+                # snapshots (the next snapshot rewrites them as JSON)
                 try:
-                    n = self.cache.load(persist)
+                    n = self.cache.load(
+                        persist,
+                        allow_pickle=self.config.cache.persist_allow_pickle)
                     logger.info("restored %d cache entries from %s",
                                 n, persist)
                 except Exception:
@@ -122,6 +126,7 @@ class Coordinator:
         self._running = False
         self._cache_hits = 0
         self._submitted = 0
+        self._overload_rejections = 0   # worker sheds seen (typed error)
         self._model_configs: Dict[str, ModelConfig] = {}
         self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
         # disaggregated deployments: model -> (prefill worker ids, rr cursor)
@@ -356,6 +361,18 @@ class Coordinator:
             model, version, inputs, request_id=request_id, trace=trace
         )
         result: Dict[str, Any] = await future
+        if result.get("finish_reason") == "overloaded":
+            # client-visible typed outcome (VERDICT r2 item 2): every
+            # replica the dispatch tried shed this request — the caller
+            # must back off, and the outcome must never enter the cache
+            from ..engine.types import EngineOverloadedError
+
+            raise EngineOverloadedError(
+                f"request {request_id} shed by every tried replica "
+                f"({result.get('metadata', {}).get('overload_reason', '?')})"
+                "; back off and retry",
+                reason=result.get("metadata", {}).get("overload_reason",
+                                                      "queue_full"))
         trace.mark("done")
         result = dict(result)
         result["cached"] = False
@@ -450,6 +467,35 @@ class Coordinator:
             worker_id = alt
             result = await self._stream_once(model, worker_id, req,
                                              counting_cb)
+        except WorkerRPCError as e:
+            # streaming shed: same contract as the batch path (one
+            # alternate, then the typed error + counter) — nothing has
+            # streamed yet when the shed happens at admission
+            if getattr(e, "kind", "") != "overloaded" or delivered:
+                raise
+            from ..engine.types import EngineOverloadedError
+
+            alt = self._pick_alternate(model, version, worker_id,
+                                       affinity, sharded)
+            if alt is not None:
+                logger.info("stream shed by %s — retrying on %s",
+                            worker_id, alt)
+                try:
+                    worker_id = alt
+                    result = await self._stream_once(model, worker_id, req,
+                                                     counting_cb)
+                except WorkerRPCError as e2:
+                    if getattr(e2, "kind", "") != "overloaded":
+                        raise
+                    self._overload_rejections += 1
+                    raise EngineOverloadedError(
+                        f"request {request_id} shed by every tried "
+                        "replica; back off and retry") from e2
+            else:
+                self._overload_rejections += 1
+                raise EngineOverloadedError(
+                    f"request {request_id} shed ({e}); back off and "
+                    "retry") from e
         trace.mark("done")
         out = result_to_dict(result)
         out["cached"] = False
@@ -475,8 +521,12 @@ class Coordinator:
                 timeout=self.config.dispatch_timeout_s,
             )
         except Exception as e:
-            self.lb.update_stats(worker_id, success=False,
-                                 latency_s=time.perf_counter() - t0)
+            # overloaded: neither an LB failure nor a health event (see
+            # _dispatch_once) — the streaming handler relays the engine's
+            # typed shed as an RPC error with kind="overloaded"
+            if getattr(e, "kind", "") != "overloaded":
+                self.lb.update_stats(worker_id, success=False,
+                                     latency_s=time.perf_counter() - t0)
             if not isinstance(e, WorkerRPCError):
                 self.router.mark_worker_failure(worker_id)
             raise
@@ -551,6 +601,34 @@ class Coordinator:
                 return
             for i, out in zip(idxs, outs):
                 results[i] = out
+            # sheds come back as per-request "overloaded" results while
+            # their siblings' generations stand: retry JUST the shed
+            # subset, once, on one alternate replica — an overloaded
+            # worker is busy, not unhealthy, and retry loops would only
+            # move the overload around the fleet
+            shed = [i for i, out in zip(idxs, outs)
+                    if isinstance(out, dict)
+                    and out.get("finish_reason") == "overloaded"]
+            if not shed:
+                return
+            alt = self._pick_alternate(model, version, worker_id,
+                                       reals[shed[0]]["key"], sharded)
+            if alt is not None:
+                logger.info("%d request(s) shed by %s — retrying on %s",
+                            len(shed), worker_id, alt)
+                try:
+                    retry_outs = await self._dispatch_once(
+                        model, alt, [request_from_dict(reals[i])
+                                     for i in shed])
+                    for i, out in zip(shed, retry_outs):
+                        results[i] = out
+                except Exception:
+                    logger.warning("shed-retry on %s failed — surfacing "
+                                   "the original overloaded outcome", alt)
+            self._overload_rejections += sum(
+                1 for i in shed
+                if isinstance(results[i], dict)
+                and results[i].get("finish_reason") == "overloaded")
 
         await asyncio.gather(*(run_group(w, idxs)
                                for w, idxs in groups.items()))
@@ -589,6 +667,35 @@ class Coordinator:
                 if alt is None:
                     raise
                 return await self._dispatch_once(model, alt, reqs)
+            if getattr(e, "kind", "") == "overloaded":
+                # batch-path sheds normally arrive as per-request results
+                # (run_group handles those); a whole-call overloaded error
+                # reaches here only from the streaming handler's typed
+                # raise relayed through a batch call — defense in depth:
+                # one alternate, then surface. _overload_rejections counts
+                # FINAL client-visible sheds only (same meaning as
+                # run_group's per-request count), so a successful
+                # alternate dispatch is not a rejection
+                alt = self._pick_alternate(model, version, worker_id,
+                                           keys[0], sharded)
+                if alt is None:
+                    self._overload_rejections += 1
+                    raise
+                logger.info("worker %s overloaded — trying alternate %s",
+                            worker_id, alt)
+                try:
+                    return await self._dispatch_once(model, alt, reqs)
+                except WorkerRPCError as e2:
+                    if getattr(e2, "kind", "") != "overloaded":
+                        raise
+                    # both replicas shed: count + typed error, same
+                    # contract as the streaming path
+                    self._overload_rejections += 1
+                    from ..engine.types import EngineOverloadedError
+
+                    raise EngineOverloadedError(
+                        "request shed by every tried replica; back off "
+                        "and retry") from e2
             raise
 
     def _pick_alternate(self, model: str, version: str, failed: str,
@@ -628,9 +735,15 @@ class Coordinator:
             # every failed request counts against the worker's LB stats
             # (reference update_stats semantics); only transport-level
             # trouble additionally dents router health — an app error
-            # (e.g. bad model name) doesn't mean the worker is down
-            self.lb.update_stats(worker_id, success=False,
-                                 latency_s=time.perf_counter() - t0)
+            # (e.g. bad model name) doesn't mean the worker is down.
+            # Overload sheds count as NEITHER: success=False feeds the
+            # LB's consecutive-failure eviction, and evicting the busiest
+            # worker shifts its load onto the rest and cascades (r3
+            # review finding) — a shed worker served exactly what it was
+            # asked to: a fast typed refusal
+            if getattr(e, "kind", "") != "overloaded":
+                self.lb.update_stats(worker_id, success=False,
+                                     latency_s=time.perf_counter() - t0)
             if not isinstance(e, WorkerRPCError):
                 self.router.mark_worker_failure(worker_id)
             raise
@@ -735,11 +848,12 @@ class Coordinator:
         # atomic replace: a crash mid-write must not corrupt the snapshot
         atomic_write(path, lambda f: json.dump(state, f, indent=2))
         if self.config.cache.persist_path:
-            # cache snapshot rides the state snapshot (its own file: pickle
-            # payloads don't belong inside the JSON control-plane record).
-            # Best-effort, symmetric with the startup-side load: the cache
-            # is an optimization — its save failing must not fail the
-            # control-plane snapshot that already landed
+            # cache snapshot rides the state snapshot in its own file —
+            # entry payloads (and their volume) don't belong inside the
+            # control-plane record. Best-effort, symmetric with the
+            # startup-side load: the cache is an optimization — its save
+            # failing must not fail the control-plane snapshot that
+            # already landed
             try:
                 self.cache.save(self.config.cache.persist_path)
             except Exception:
@@ -832,6 +946,7 @@ class Coordinator:
         return {
             "submitted": self._submitted,
             "cache_hits": self._cache_hits,
+            "overload_rejections": self._overload_rejections,
             "cache": self.cache.get_stats(),
             "batcher": self.batcher.get_stats(),
             "router": self.router.get_stats(),

@@ -190,6 +190,7 @@ class WorkerServer(FramedServerMixin):
         # generate-path counters, kept apart from probe counters (see module doc)
         self._request_count = 0
         self._error_count = 0
+        self._overloaded_count = 0     # load sheds, apart from real errors
         self._ping_count = 0
         self._active_connections = 0
         self.latency = LatencyStats()
@@ -362,6 +363,12 @@ class WorkerServer(FramedServerMixin):
 
     def _on_handler_error(self, method: str, exc: Exception) -> None:
         if method in ("generate", "generate_stream"):
+            # load sheds are the engine WORKING as configured, not a fault:
+            # counting them would let sustained overload trip the same
+            # error-rate signals a sick worker trips
+            if getattr(exc, "rpc_error_kind", "") == "overloaded":
+                self._overloaded_count += 1
+                return
             self._error_count += 1
 
     def _after_dispatch(self, method: str, req_id: str,
@@ -396,6 +403,11 @@ class WorkerServer(FramedServerMixin):
             results = await loop.run_in_executor(
                 self._executor, engine.generate, reqs
             )
+        # sheds are per-request RESULTS (finish_reason "overloaded"), so
+        # they bypass _on_handler_error — count them here, still apart
+        # from real errors
+        self._overloaded_count += sum(
+            1 for r in results if r.finish_reason == "overloaded")
         return {"model": name, "results": [result_to_dict(r) for r in results]}
 
     # -- streaming (token chunks ahead of the final result) -----------------
@@ -635,6 +647,7 @@ class WorkerServer(FramedServerMixin):
             "uptime_s": time.time() - self._started_at if self._started_at else 0.0,
             "request_count": self._request_count,
             "error_count": self._error_count,
+            "overloaded_count": self._overloaded_count,
             "ping_count": self._ping_count,          # probes counted apart
             "active_connections": self._active_connections,
             "latency": self.latency.snapshot(),

@@ -108,6 +108,16 @@ class EngineConfig:
                                        # this prefill in chunks interleaved with
                                        # decode (0 = whole-prompt prefill);
                                        # rounded to a multiple of page_size
+    # ---- overload handling (continuous engine; VERDICT r2 item 2) ----
+    max_waiting: int = 0               # waiting-queue cap: submit raises a
+                                       # typed EngineOverloadedError once
+                                       # this many requests are queued
+                                       # (0 = unbounded)
+    queue_deadline_s: float = 0.0      # shed requests still waiting for a
+                                       # slot after this long: resolved as
+                                       # finish_reason="overloaded" (pump/
+                                       # RPC surface it as the typed error;
+                                       # 0 = never shed)
 
 
 @dataclass
@@ -130,8 +140,13 @@ class CacheConfig:
     # optional persistence (the reference README's declared-but-unbuilt
     # surface, ``/root/reference/README.md:14,90``): when set, the
     # coordinator restores the cache from this file at startup and
-    # snapshots it alongside ``save_state``
+    # snapshots it alongside ``save_state``. Snapshots are JSON (non-
+    # executable) by default; a pre-r3 pickle snapshot loads only with
+    # persist_allow_pickle=True — the operator's acknowledgement that the
+    # snapshot path is writable by them alone (unpickling runs code from
+    # the file; ADVICE r2)
     persist_path: Optional[str] = None
+    persist_allow_pickle: bool = False
 
 
 @dataclass

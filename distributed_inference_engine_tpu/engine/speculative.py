@@ -384,6 +384,14 @@ class SpeculativeEngine:
         t1 = time.perf_counter()
         act_host = active_np
         scanned = [0] * n        # host-stop scan resume offsets
+        # the prefill-sampled FIRST token can itself match stop_ids/
+        # stop_sequences (ADVICE r2): scan before the loop so such a
+        # request never burns a target+draft round
+        stopped_rows = scan_host_stops(out_tokens, requests, act_host,
+                                       scanned)
+        if stopped_rows and act_host.any():
+            active = active.at[
+                jnp.asarray(stopped_rows, jnp.int32)].set(False)
         while act_host.any():
             self._rng, kr = jax.random.split(self._rng)
             (tck, tcv, dck, dcv, lengths, last, active,

@@ -11,6 +11,26 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Tuple
 
 
+# machine-readable error class for load shedding: the engine's waiting
+# queue is full (hard backpressure at submit) or the request sat in queue
+# past its deadline (shed at admission). The coordinator reacts by trying
+# ONE alternate replica, then surfaces the typed error to the client —
+# an overloaded worker is NOT an unhealthy worker (the reference's only
+# notions of bounding: ``/root/reference/src/batcher.py:140-147`` batch
+# cap, ``src/load_balancer.py:150-153`` healthy-set filter).
+OVERLOADED = "overloaded"
+
+
+class EngineOverloadedError(RuntimeError):
+    """The engine shed this request instead of queueing it unboundedly."""
+
+    rpc_error_kind = OVERLOADED
+
+    def __init__(self, msg: str, reason: str = "queue_full") -> None:
+        super().__init__(msg)
+        self.reason = reason            # "queue_full" | "deadline"
+
+
 @dataclass
 class GenerationRequest:
     """One generation job (token-id space; tokenization is a host concern)."""

@@ -178,7 +178,8 @@ def engine_from_config(cfg):
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",
               "attention_impl", "kv_dtype", "prefill_buckets",
-              "prefix_cache", "prefill_chunk", "decode_mode"):
+              "prefix_cache", "prefill_chunk", "decode_mode",
+              "max_waiting", "queue_deadline_s"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

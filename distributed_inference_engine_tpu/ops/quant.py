@@ -145,9 +145,11 @@ def random_quantized_params(spec, key, w_std: float = 0.02) -> Dict[str, Any]:
     the bf16 tree plus the per-leaf f32 working copy peaks well above the
     model's own HBM footprint on exactly the single-chip int8 deploys
     quantization exists for (16 GB v5e, BASELINE.md rung 3). Here every
-    quantizable weight is born int8 (uniform random payload, constant
-    per-channel scale ``w_std/127`` ⇒ effective weight std ≈ ``w_std``,
-    matching ``init_params``); norms init to ones, biases to zeros, and
+    quantizable weight is born int8 (uniform random payload — whose std is
+    ``127/sqrt(3)`` — at constant per-channel scale ``w_std*sqrt(3)/127``,
+    so the effective weight std is ≈ ``w_std``, matching ``init_params``;
+    ADVICE r2 caught the earlier ``w_std/127``, which undershot ~0.58x);
+    norms init to ones, biases to zeros, and
     full-precision leaves (embeddings, router) to scaled normals. FLOP
     and byte counts are identical to a quantized real checkpoint, which
     is all random-init serving is for.
@@ -166,7 +168,8 @@ def random_quantized_params(spec, key, w_std: float = 0.02) -> Dict[str, Any]:
         s_shape = tuple(1 if i in axes else d
                         for i, d in enumerate(leaf.shape))
         return QuantizedTensor(
-            q=q, s=jnp.full(s_shape, w_std / 127.0, jnp.float32))
+            q=q, s=jnp.full(s_shape, w_std * (3.0 ** 0.5) / 127.0,
+                            jnp.float32))
 
     def f_leaf(name, leaf):
         if "scale" in name:

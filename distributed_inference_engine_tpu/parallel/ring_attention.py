@@ -32,7 +32,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int):
+def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int,
+               window: int = 0):
     """Per-device body: q/k/v are LOCAL blocks [B, Tl, H|Hkv, Dh]."""
     b, tl, h, dh = q.shape
     g = h // n_kv_heads
@@ -65,6 +66,15 @@ def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int):
             "bikgd,bjkd->bkgij", qg, k_blk
         ).astype(jnp.float32) * scale                              # [B,Hkv,G,Tl,Tl]
         mask = k_pos[None, :] <= q_pos[:, None]                    # [Tl, Tl] causal
+        if window:
+            # sliding window, same convention as ops.attention
+            # .causal_attention ((i - j) < window): absolute positions make
+            # the mask rotation-invariant — each step just masks the block
+            # it happens to hold. Blocks wholly outside every query's
+            # window accumulate zero (their rotation still runs; a
+            # skip-if-far optimization would save ICI hops only when
+            # window << T/sp, not worth divergent control flow here).
+            mask &= (q_pos[:, None] - k_pos[None, :]) < window
         if seq_lens is not None:
             mask = mask[None] & (k_pos[None, None, :] < seq_lens[:, None, None])
             mask = mask[:, None, None]                             # [B,1,1,Tl,Tl]
@@ -101,12 +111,14 @@ def ring_attention(
     mesh: Mesh,
     seq_lens: Optional[jnp.ndarray] = None,   # [B] valid lengths
     axis: str = "sp",
+    window: int = 0,          # sliding-window size (0 = full causal)
 ) -> jnp.ndarray:
-    """Causal (optionally length-masked) attention with T sharded over
-    ``axis``. Requires T % axis_size == 0. Returns [B, T, H, Dh] with the
-    same sequence sharding."""
+    """Causal (optionally length-masked, optionally sliding-window)
+    attention with T sharded over ``axis``. Requires T % axis_size == 0.
+    Returns [B, T, H, Dh] with the same sequence sharding."""
     n_kv = k.shape[2]
-    body = functools.partial(_ring_body, axis=axis, n_kv_heads=n_kv)
+    body = functools.partial(_ring_body, axis=axis, n_kv_heads=n_kv,
+                             window=window)
     seq_spec = P(None, axis, None, None)
     in_specs = (seq_spec, seq_spec, seq_spec)
     if seq_lens is not None:

@@ -161,10 +161,17 @@ def compatible_sharding(base: NamedSharding, shape) -> NamedSharding:
             new.append(None)
             continue
         names = (axes,) if isinstance(axes, str) else axes
+        # a user-built mesh may lack an axis make_mesh always names (e.g.
+        # no "sp"): a missing axis is dropped from the spec — replicated —
+        # instead of a KeyError at first generate (ADVICE r2)
+        present = [nm for nm in names if nm in base.mesh.shape]
         size = 1
-        for nm in names:
+        for nm in present:
             size *= base.mesh.shape[nm]
-        new.append(axes if size and dim % size == 0 else None)
+        if present and size and dim % size == 0:
+            new.append(present[0] if len(present) == 1 else tuple(present))
+        else:
+            new.append(None)
     return NamedSharding(base.mesh, P(*new))
 
 

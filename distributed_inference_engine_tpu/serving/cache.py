@@ -169,7 +169,50 @@ class ResponseCache:
 
     # --------------------------------------------------------- persistence
 
-    def save(self, path: str) -> int:
+    # reserved single-key dict forms the tagged encoding emits; a USER dict
+    # that happens to be exactly one of these shapes is wrapped in __esc__
+    # so it round-trips as a dict instead of silently decoding as a tag
+    _TAGS = frozenset({"__tuple__", "__esc__"})
+
+    @staticmethod
+    def _enc(obj):
+        """JSON-safe tagged encoding of keys/values: tuples become
+        ``{"__tuple__": [...]}`` (cache keys are tuples of model/version/
+        prompt-token tuples); everything else must already be JSON
+        (dict-with-str-keys / list / str / numbers / bool / None)."""
+        if isinstance(obj, tuple):
+            return {"__tuple__": [ResponseCache._enc(x) for x in obj]}
+        if isinstance(obj, list):
+            return [ResponseCache._enc(x) for x in obj]
+        if isinstance(obj, dict):
+            if any(not isinstance(k, str) for k in obj):
+                raise TypeError("dict keys must be str for a JSON snapshot")
+            enc = {k: ResponseCache._enc(v) for k, v in obj.items()}
+            if len(enc) == 1 and next(iter(enc)) in ResponseCache._TAGS:
+                return {"__esc__": enc}          # collider dict, escaped
+            return enc
+        if obj is None or isinstance(obj, (str, int, float, bool)):
+            return obj
+        raise TypeError(
+            f"{type(obj).__name__} is not JSON-snapshot-serializable; "
+            "pass format='pickle' (trusted snapshot dirs only)")
+
+    @staticmethod
+    def _dec(obj):
+        if isinstance(obj, dict):
+            if set(obj) == {"__tuple__"}:
+                return tuple(ResponseCache._dec(x) for x in obj["__tuple__"])
+            if set(obj) == {"__esc__"}:
+                # escaped collider: the inner dict's values were encoded
+                # but the dict itself is data, not a tag
+                return {k: ResponseCache._dec(v)
+                        for k, v in obj["__esc__"].items()}
+            return {k: ResponseCache._dec(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [ResponseCache._dec(x) for x in obj]
+        return obj
+
+    def save(self, path: str, format: str = "json") -> int:
         """Persist live entries to ``path`` (the "optional persistence" the
         reference README declares for its KV store but never implements —
         ``/root/reference/README.md:14,90``). Returns entries written.
@@ -177,11 +220,17 @@ class ResponseCache:
         TTLs are stored as REMAINING seconds: ``created_at`` is
         ``time.monotonic()``, which is meaningless across processes, so an
         entry with 30 s left saves as 30 and its clock restarts on load.
-        Expired entries are dropped at save. Pickle format (values are
-        arbitrary Python response payloads); written atomically so a crash
-        mid-write can't corrupt a previous snapshot."""
-        import pickle
+        Expired entries are dropped at save. Written atomically so a crash
+        mid-write can't corrupt a previous snapshot.
 
+        ``format="json"`` (default) writes a non-executable snapshot —
+        loading one can't run code, whatever wrote the file. Tuple keys
+        round-trip via a tagged encoding; values must be JSON-shaped (the
+        coordinator's response payloads are — they travel the framed JSON
+        RPC). ``format="pickle"`` handles arbitrary payloads but executes
+        arbitrary code at load: use it only when the snapshot path is
+        writable by the operator alone, and pass ``allow_pickle=True`` to
+        ``load`` to acknowledge that trust boundary (ADVICE r2)."""
         from ..utils.files import atomic_write
 
         with self._lock:
@@ -195,18 +244,48 @@ class ResponseCache:
                              else max(0.0, e.ttl - (now - e.created_at)))
                 rows.append((k, e.value, remaining, e.access_count))
         payload = {"version": 1, "policy": self.policy.value, "rows": rows}
-        atomic_write(path, lambda f: pickle.dump(payload, f), binary=True)
+        if format == "json":
+            import json
+
+            payload["rows"] = [self._enc(list(r)) for r in rows]
+            blob = json.dumps(payload).encode()
+            atomic_write(path, lambda f: f.write(blob), binary=True)
+        elif format == "pickle":
+            import pickle
+
+            atomic_write(path, lambda f: pickle.dump(payload, f),
+                         binary=True)
+        else:
+            raise ValueError(f"unknown snapshot format {format!r}")
         return len(rows)
 
-    def load(self, path: str) -> int:
+    def load(self, path: str, allow_pickle: bool = False) -> int:
         """Restore a ``save`` snapshot into this cache: loaded keys
         overwrite, other existing entries are kept, capacity eviction
         applies normally. Entries whose remaining TTL reached zero are
-        skipped. Returns entries restored."""
-        import pickle
+        skipped. Returns entries restored.
 
+        The format is detected from the file. Pickle snapshots load only
+        with ``allow_pickle=True``: unpickling executes code from the
+        file, so the caller must vouch that the snapshot path is
+        operator-only writable (see ``save``)."""
         with open(path, "rb") as f:
-            payload = pickle.load(f)
+            head = f.read(1)
+            blob = head + f.read()
+        if head == b"{":
+            import json
+
+            payload = json.loads(blob)
+            payload["rows"] = [self._dec(r) for r in payload["rows"]]
+        else:
+            if not allow_pickle:
+                raise ValueError(
+                    f"cache snapshot {path!r} is a pickle; loading one "
+                    "executes code from the file. Pass allow_pickle=True "
+                    "only if the snapshot dir is operator-only writable.")
+            import pickle
+
+            payload = pickle.loads(blob)
         version = payload.get("version")
         if version != 1:
             # the version field exists exactly so a format bump fails with

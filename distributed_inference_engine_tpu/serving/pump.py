@@ -28,7 +28,11 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..engine.types import GenerationRequest, GenerationResult
+from ..engine.types import (
+    EngineOverloadedError,
+    GenerationRequest,
+    GenerationResult,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -58,7 +62,16 @@ class EnginePump:
 
     async def generate(self, requests: List[GenerationRequest]
                        ) -> List[GenerationResult]:
-        """Submit into the rolling batch; resolves when all finish."""
+        """Submit into the rolling batch; resolves when all finish.
+
+        Overload is a PER-REQUEST outcome: a shed request comes back as a
+        result with ``finish_reason="overloaded"`` (zero tokens) while its
+        batch siblings complete normally — an exception here would discard
+        siblings' generations and push callers into whole-batch retries
+        that duplicate work during the very overload being shed (r3 review
+        finding). Single-request surfaces (``generate_streaming``, the
+        coordinator's ``submit``) convert the outcome to the typed
+        ``EngineOverloadedError``."""
         return await self._submit_all([(r, None) for r in requests])
 
     async def generate_prefilled(
@@ -73,10 +86,18 @@ class EnginePump:
     ) -> GenerationResult:
         """Like ``generate`` for one request, but ``on_tokens(tokens)`` is
         invoked on THIS loop with each batch of fresh tokens as the engine
-        produces them (trimmed like the final result)."""
+        produces them (trimmed like the final result). A shed request
+        raises the typed ``EngineOverloadedError`` (single-request surface
+        — there are no siblings to protect)."""
         results = await self._submit_all([(request, None)],
                                          on_tokens=on_tokens)
-        return results[0]
+        res = results[0]
+        if res.finish_reason == "overloaded":
+            reason = res.metadata.get("overload_reason", "queue_full")
+            raise EngineOverloadedError(
+                f"request {res.request_id} shed ({reason}); retry on "
+                "another replica or later", reason=reason)
+        return res
 
     async def _submit_all(
         self, pairs: List[Tuple[GenerationRequest, Any]], on_tokens=None,
@@ -170,6 +191,18 @@ class EnginePump:
                     self.engine.submit_prefilled(req, handoff, on_tokens=cb)
                 else:
                     self.engine.submit(req, on_tokens=cb)
+            except EngineOverloadedError as e:
+                # per-request outcome, not an exception: batch siblings
+                # already submitted must keep their futures resolvable
+                # with real results (see generate())
+                del self._futures[pump_id]
+                shed = GenerationResult(
+                    request_id=original_id or pump_id, tokens=[],
+                    finish_reason="overloaded",
+                    prompt_tokens=len(req.prompt),
+                    metadata={"overload_reason": e.reason},
+                )
+                loop.call_soon_threadsafe(self._set_result, fut, shed)
             except Exception as e:
                 del self._futures[pump_id]
                 loop.call_soon_threadsafe(self._set_exc, fut, e)

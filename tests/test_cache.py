@@ -245,3 +245,43 @@ def test_save_is_atomic_over_existing_snapshot(tmp_path):
     c.save(p)                             # overwrite in place
     c2 = ResponseCache()
     assert c2.load(p) == 2
+
+
+def test_snapshot_json_tuple_keys_and_collider_dicts(tmp_path):
+    """JSON snapshots (the non-executable default): tuple keys round-trip
+    via the tagged encoding, and a VALUE that happens to be a dict shaped
+    like the tag (single key "__tuple__" or "__esc__") is escaped so it
+    comes back as the same dict, not silently converted to a tuple."""
+    p = str(tmp_path / "snap.json")
+    c = ResponseCache()
+    key = ("m", "1.0", (1, 2, 3), 8)
+    c.set(key, {"tokens": [7], "inner": ("a", "b")})
+    c.set("collider", {"__tuple__": [1, 2]})
+    c.set("collider2", {"__esc__": {"x": 1}})
+    c.save(p)
+    with open(p, "rb") as f:
+        assert f.read(1) == b"{"              # JSON, not pickle
+    c2 = ResponseCache()
+    assert c2.load(p) == 3
+    assert c2.get(key) == {"tokens": [7], "inner": ("a", "b")}
+    assert c2.get("collider") == {"__tuple__": [1, 2]}
+    assert c2.get("collider2") == {"__esc__": {"x": 1}}
+
+
+def test_snapshot_pickle_requires_opt_in(tmp_path):
+    """Unpickling executes code from the file: loading a pickle snapshot
+    demands an explicit allow_pickle=True acknowledgement of the trust
+    boundary (ADVICE r2), and non-JSON payloads demand format='pickle'."""
+    import pytest
+
+    p = str(tmp_path / "snap.bin")
+    c = ResponseCache()
+    c.set("k", {1, 2, 3})                     # a set is not JSON-shaped
+    with pytest.raises(TypeError, match="pickle"):
+        c.save(p)                             # JSON default refuses
+    c.save(p, format="pickle")
+    c2 = ResponseCache()
+    with pytest.raises(ValueError, match="allow_pickle"):
+        c2.load(p)
+    assert c2.load(p, allow_pickle=True) == 1
+    assert c2.get("k") == {1, 2, 3}

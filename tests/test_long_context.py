@@ -89,18 +89,44 @@ def test_prefill_engine_with_sp_mesh_handoff_parity():
         rtol=2e-2, atol=2e-2)   # kv dtype is bf16
 
 
-def test_sp_prefill_rejects_misaligned_bucket_and_window():
+def test_sp_prefill_rejects_misaligned_bucket():
     mesh = _mesh()
     params = init_params(SPEC, jax.random.key(0))
     tokens = jnp.ones((1, 30), jnp.int32)        # 30 % 4 != 0
     with pytest.raises(ValueError, match="not divisible by sp"):
         sp_forward_prefill(SPEC, params, tokens, jnp.asarray([30]), mesh)
+
+
+def test_sp_prefill_sliding_window_matches_dense():
+    """Sliding-window specs (Mistral) prefill sequence-parallel: the window
+    mask rides absolute positions through the ring rotation (VERDICT r2
+    item 9) — exact parity with the dense sliding-window prefill, window
+    spanning block boundaries included (64-token blocks, window 48)."""
+    mesh = _mesh()
     wspec = mistral_spec("mistral-tiny", max_seq_len=256).replace(
-        dtype="float32")
+        dtype="float32", sliding_window=48)
+    assert wspec.sliding_window == 48
     wparams = init_params(wspec, jax.random.key(0))
-    with pytest.raises(ValueError, match="sliding-window"):
-        sp_forward_prefill(wspec, wparams, jnp.ones((1, 64), jnp.int32),
-                           jnp.asarray([64]), mesh)
+    rs = np.random.RandomState(2)
+    tokens = jnp.asarray(rs.randint(1, 1000, (2, 256)), jnp.int32)
+    lens = jnp.asarray([256, 200], jnp.int32)
+    h_ref, k_ref, v_ref = forward_prefill(wspec, wparams, tokens, lens)
+    h_sp, k_sp, v_sp = sp_forward_prefill(wspec, wparams, tokens, lens, mesh)
+    # compare VALID positions only: a padded query more than `window` past
+    # its row's end has zero attendable keys — the dense softmax emits
+    # uniform garbage there, the ring's online softmax emits zeros, and
+    # deeper layers propagate the difference. Engines never read padded
+    # positions (the KV page write masks by seq_len).
+    valid = (np.arange(256)[None, :] < np.asarray(lens)[:, None])
+    for got, ref in ((h_sp, h_ref), (k_sp, k_ref), (v_sp, v_ref)):
+        got, ref = np.asarray(got), np.asarray(ref)
+        if got.ndim == 5:                       # [L, B, T, Hkv, Dh]
+            m = valid[None, :, :, None, None]
+        else:                                   # [B, T, D]
+            m = valid[:, :, None]
+        np.testing.assert_allclose(np.where(m, got, 0.0),
+                                   np.where(m, ref, 0.0),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_prefill_fn_selector():
@@ -113,16 +139,60 @@ def test_prefill_fn_selector():
 def test_engine_construction_fails_fast_on_bad_sp_config():
     """Misconfiguration must fail the deploy, not the first request."""
     mesh = _mesh()
-    wspec = mistral_spec("mistral-tiny", max_seq_len=256).replace(
-        dtype="float32")
-    with pytest.raises(ValueError, match="sliding-window"):
-        Engine(wspec, config=EngineConfig(max_slots=2, max_seq_len=256,
-                                          prefill_buckets=[64]),
-               sp_mesh=mesh)
     with pytest.raises(ValueError, match="not divisible by sp"):
         Engine(SPEC, config=EngineConfig(max_slots=2, max_seq_len=256,
                                          prefill_buckets=[30]),
                sp_mesh=mesh)
+
+
+def test_sliding_window_engine_serves_under_sp():
+    """End-to-end: a sliding-window (Mistral) engine deployed with an sp
+    mesh — ring prefill with the window mask, sequence-sharded decode —
+    generates the same greedy tokens as the unsharded engine. The last
+    documented sp corner (VERDICT r2 item 9)."""
+    mesh = _mesh(sp=2, dp=1)
+    wspec = mistral_spec("mistral-tiny", max_seq_len=256).replace(
+        dtype="float32", sliding_window=24)
+    cfg = EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=[64])
+    from distributed_inference_engine_tpu.parallel.sharding import (
+        ModelShardings,
+        shard_params,
+    )
+
+    shardings = ModelShardings.build(wspec, mesh)
+    sp_eng = Engine(wspec, config=cfg, seed=5,
+                    shard_fn=lambda p: shard_params(p, shardings),
+                    sp_mesh=mesh)
+    plain = Engine(wspec, config=cfg, seed=5)
+    prompt = list(range(1, 60))
+    req = lambda: [GenerationRequest(prompt=prompt, max_new_tokens=5)]
+    t_sp = sp_eng.generate(req())[0].tokens
+    t_pl = plain.generate(req())[0].tokens
+    assert t_sp[0] == t_pl[0]          # chains may flip on fp near-ties
+    assert len(t_sp) == len(t_pl) == 5
+    # FULL-CHAIN check (same scheme as __graft_entry__'s sp-decode
+    # verification): teacher-force the sp chain through the unsharded
+    # forward — every sp token must be the unsharded argmax given the
+    # same prefix, skipping only fp near-ties. Catches window-mask bugs
+    # that surface mid-decode (e.g. once the generated length crosses a
+    # block or window boundary), which a first-token check cannot.
+    from distributed_inference_engine_tpu.models.base import forward_train
+
+    seq = jnp.asarray([prompt + t_sp], jnp.int32)
+    logits = np.asarray(forward_train(
+        wspec, plain.params, seq,
+        jnp.full((1,), seq.shape[1], jnp.int32)))[0]
+    matched = 0
+    for i, tok in enumerate(t_sp):
+        lg = logits[len(prompt) - 1 + i]
+        top2 = np.sort(lg)[-2:]
+        if float(top2[1] - top2[0]) < 5e-3:
+            continue                               # fp near-tie: skip
+        assert int(lg.argmax()) == tok, (
+            f"sp sliding-window decode step {i}: got {tok}, unsharded "
+            f"argmax {int(lg.argmax())}")
+        matched += 1
+    assert matched >= 3, f"only {matched}/5 non-tie steps verified"
 
 
 def test_sp_decode_cache_stays_sequence_sharded():
