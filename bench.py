@@ -26,6 +26,10 @@ Env knobs:
     BENCH_KV_DTYPE paged-KV dtype (continuous; default bfloat16)
     BENCH_DECODE_MODE  window | inline (default: window for 8B-class,
                    inline for small-KV models — the measured crossover)
+    BENCH_ENGINE=speculative: draft = the target's own first
+                   BENCH_DRAFT_LAYERS layers (default 8), k=BENCH_SPEC_K
+                   (default 4) — deterministic acceptance from shared
+                   structure (engine.speculative.truncated_draft)
     serving mode:  BENCH_RATE (req/s Poisson, default 16),
                    BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16),
                    BENCH_MAX_WAITING (queue cap, default 4x slots; 0 = off),
@@ -107,10 +111,31 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
     )
     if os.environ.get("BENCH_KV_DTYPE"):
         cfg.kv_dtype = os.environ["BENCH_KV_DTYPE"]
+    if os.environ.get("BENCH_ATTN"):
+        cfg.attention_impl = os.environ["BENCH_ATTN"]
     if kind == "static":
         from distributed_inference_engine_tpu.engine.engine import Engine
 
         return Engine(spec, params=params, config=cfg)
+    if kind == "speculative":
+        import jax
+
+        from distributed_inference_engine_tpu.engine.speculative import (
+            SpeculativeEngine,
+            truncated_draft,
+        )
+
+        if params is None:
+            from distributed_inference_engine_tpu.models.base import (
+                init_params,
+            )
+
+            params = init_params(spec, jax.random.key(0))
+        d_spec, d_params = truncated_draft(
+            spec, params, int(os.environ.get("BENCH_DRAFT_LAYERS", "8")))
+        return SpeculativeEngine(
+            spec, d_spec, params=params, draft_params=d_params, config=cfg,
+            speculate_k=int(os.environ.get("BENCH_SPEC_K", "4")))
     from distributed_inference_engine_tpu.engine.continuous import (
         ContinuousEngine,
     )
@@ -219,16 +244,28 @@ def decode_main() -> None:
     roof = _roofline(spec, engine.params, BATCH, best_toks, kv_bytes)
     ttft_ms = sorted(ttfts)[len(ttfts) // 2] * 1e3
     log(f"p50 TTFT: {ttft_ms:.1f} ms; roofline: {roof}")
-    print(json.dumps({
+    suffix = "" if ENGINE_KIND == "continuous" else f"_{ENGINE_KIND}"
+    row = {
         "metric": f"decode_throughput_{MODEL}{'_int8' if QUANT else ''}"
-                  f"_bs{BATCH}",
+                  f"_bs{BATCH}{suffix}",
         "value": round(best_toks, 1),
         "unit": "tok/s",
         "vs_baseline": round(best_toks / NORTH_STAR_TOKS, 2),
         "hbm_util": roof["hbm_util"],
         "achieved_gbps": roof["achieved_gbps"],
         "ttft_p50_ms": round(ttft_ms, 1),
-    }), flush=True)
+    }
+    m = engine.get_metrics()
+    if "draft_acceptance_rate" in m:
+        row["acceptance"] = round(m["draft_acceptance_rate"], 3)
+        row["tokens_per_round"] = round(m["tokens_per_round"], 2)
+        row["speculate_k"] = m["speculate_k"]
+        # the roofline model assumes one weight pass per decode step per
+        # token — speculation exists to break that assumption, so the
+        # util fields would be nonsense here
+        row.pop("hbm_util", None)
+        row.pop("achieved_gbps", None)
+    print(json.dumps(row), flush=True)
 
 
 def serving_main() -> None:

@@ -52,6 +52,15 @@ from ..utils.tracing import RequestTrace, new_request_id
 
 logger = logging.getLogger(__name__)
 
+
+def _shed_reason(exc) -> str:
+    """Best-effort recovery of the shed reason from a relayed overloaded
+    RPC error: the engine's structured ``reason`` doesn't survive the wire
+    (only ``error_kind`` + message text do), but both shed messages name
+    their cause — clients distinguish "queue_full" (retry elsewhere now)
+    from "deadline" (the request aged out; shorten timeouts)."""
+    return "deadline" if "deadline" in str(exc) else "queue_full"
+
 # transport-level trouble ⇒ health signal + retry; application errors
 # (WorkerRPCError) propagate to the caller untouched
 _TRANSPORT_ERRORS = (OSError, ConnectionError, asyncio.TimeoutError,
@@ -490,12 +499,13 @@ class Coordinator:
                     self._overload_rejections += 1
                     raise EngineOverloadedError(
                         f"request {request_id} shed by every tried "
-                        "replica; back off and retry") from e2
+                        "replica; back off and retry",
+                        reason=_shed_reason(e2)) from e2
             else:
                 self._overload_rejections += 1
                 raise EngineOverloadedError(
                     f"request {request_id} shed ({e}); back off and "
-                    "retry") from e
+                    "retry", reason=_shed_reason(e)) from e
         trace.mark("done")
         out = result_to_dict(result)
         out["cached"] = False
@@ -695,7 +705,7 @@ class Coordinator:
 
                     raise EngineOverloadedError(
                         "request shed by every tried replica; back off "
-                        "and retry") from e2
+                        "and retry", reason=_shed_reason(e2)) from e2
             raise
 
     def _pick_alternate(self, model: str, version: str, failed: str,
@@ -786,9 +796,16 @@ class Coordinator:
         self.lb.acquire(pwid)
         t0 = time.perf_counter()
         try:
+            cfg = self._model_configs.get(model)
             results = await pclient.prefill_generate(
                 model, reqs, decode_host=dinfo.host, decode_port=dinfo.port,
                 timeout=self.config.dispatch_timeout_s,
+                # deploy knob: metadata.pipeline_groups > 1 overlaps the
+                # prefill pool's compute with KV transfer + decode
+                # admission (long-prompt deploys; examples/disagg_bench.py
+                # measures the crossover)
+                pipeline_groups=int(
+                    (cfg.metadata.get("pipeline_groups", 1)) if cfg else 1),
             )
         except Exception as e:
             if getattr(e, "kind", "") == DECODE_PEER_UNREACHABLE:

@@ -1253,7 +1253,12 @@ class ContinuousEngine:
                 bb *= 2
             sizes.append(self.max_slots)
         saved_prefix = self.prefix_cache
+        saved_cap = self.config.max_waiting
         self.prefix_cache = False
+        # warmup submits whole batch buckets at once — compile priming must
+        # not trip the serving admission cap (found by the serving-sweep
+        # smoke test: max_waiting < max_slots rejected its own warmup)
+        self.config.max_waiting = 0
         try:
             for n in sizes:
                 for tb in self.prefill_buckets:
@@ -1269,6 +1274,7 @@ class ContinuousEngine:
                     runs += 1
         finally:
             self.prefix_cache = saved_prefix
+            self.config.max_waiting = saved_cap
         return runs
 
     # ------------------------------------------------------------ metrics

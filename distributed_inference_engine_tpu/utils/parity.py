@@ -1,0 +1,54 @@
+"""Teacher-forced greedy-parity checking, shared by the driver dryrun
+(``__graft_entry__.py`` sp-decode) and the sp/sliding-window tests.
+
+The problem it solves: comparing two greedy decode CHAINS token-by-token is
+unsound under resharded float reductions — a near-tie can legitimately flip
+one chain, after which every later token differs by construction. Teacher-
+forcing the candidate chain through the reference forward sidesteps that:
+each candidate token is compared against the reference argmax GIVEN THE
+SAME PREFIX, and only steps whose top-2 logit margin is inside the fp
+tolerance are skipped as genuine ties.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+
+def assert_greedy_parity(
+    spec,
+    params,
+    prompt: Sequence[int],
+    tokens: Sequence[int],
+    eps: float = 5e-3,          # >> fp32 reshard noise on O(1) logits
+    min_matched: int = 3,
+    label: str = "decode",
+) -> Tuple[int, int]:
+    """Assert every non-tie step of ``tokens`` is the reference model's
+    greedy choice after ``prompt``; returns (matched, ties). ``eps`` is
+    the top-2 logit margin below which a step counts as a tie;
+    ``min_matched`` guards against a degenerate all-ties run."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.base import forward_train
+
+    seq = jnp.asarray([list(prompt) + list(tokens)], jnp.int32)
+    logits = np.asarray(forward_train(
+        spec, params, seq, jnp.full((1,), seq.shape[1], jnp.int32)))[0]
+    matched = ties = 0
+    for i, tok in enumerate(tokens):
+        lg = logits[len(prompt) - 1 + i]
+        top2 = np.sort(lg)[-2:]
+        margin = float(top2[1] - top2[0])
+        if margin < eps:
+            ties += 1
+            continue
+        assert int(lg.argmax()) == tok, (
+            f"{label} step {i}: candidate chose {tok}, reference argmax "
+            f"{int(lg.argmax())} (margin {margin:.4f})")
+        matched += 1
+    assert matched >= min_matched, (
+        f"{label}: only {matched}/{len(tokens)} non-tie steps verified "
+        f"({ties} ties) — margin check degenerate")
+    return matched, ties

@@ -1,0 +1,187 @@
+"""Disaggregated prefill/decode: measured on real hardware (VERDICT r2 item 3).
+
+Two pools in ONE process — a prefill WorkerServer and a continuous-decode
+WorkerServer on loopback framed RPC, sharing one set of int8 weights (the
+single available chip executes both pools' programs; the wire format,
+framing, batching and handoff path are exactly the two-host deployment's).
+Measures:
+
+- handoff bytes per request (the dense [L, T, Hkv, Dh] KV payload),
+- prefill + handoff serialization/transfer time (client-observed),
+- decode-pool admission cost for handed-off KV,
+- relay end-to-end (prefill pool -> decode peer -> results) vs the SAME
+  decode engine serving the same requests single-pool.
+
+Loopback measures serialization + copy + framing; a real DCN hop adds
+bytes/bandwidth on top — the printed bytes-per-request is the number to
+divide by your DCN bandwidth (docs/design.md's estimate, now measured).
+
+Usage:  python examples/disagg_bench.py
+Knobs:  BENCH_MODEL/BENCH_QUANT/BENCH_BATCH (default 16),
+        BENCH_PROMPT (default 512), BENCH_NEW_TOKENS (default 128)
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("BENCH_BATCH", "16")
+os.environ.setdefault("BENCH_PROMPT", "512")
+
+import bench  # noqa: E402
+from distributed_inference_engine_tpu.config import (  # noqa: E402
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import (  # noqa: E402
+    WorkerClient,
+    WorkerServer,
+)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+async def main():
+    spec = bench._spec()
+    n = bench.BATCH
+    t0 = time.perf_counter()
+    params = bench._build_params(spec, bench.QUANT)
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+    from distributed_inference_engine_tpu.engine.disagg import PrefillEngine
+
+    max_seq = min(spec.max_seq_len, bench.PROMPT_LEN + bench.NEW_TOKENS)
+    ecfg = EngineConfig(
+        max_slots=n, max_seq_len=max_seq,
+        prefill_buckets=[bench.PROMPT_LEN], decode_steps_per_call=64,
+        page_size=128, num_pages=n * (-(-max_seq // 128)) + 8,
+    )
+
+    def factory(cfg: ModelConfig):
+        if cfg.metadata.get("role") == "prefill":
+            return PrefillEngine(spec, params=params, config=ecfg)
+        return ContinuousEngine(spec, params=params, config=ecfg)
+
+    pre = WorkerServer(ServerConfig(worker_id="pool-prefill", port=0,
+                                    max_frame_bytes=512 * 1024 * 1024),
+                       engine_factory=factory)
+    dec = WorkerServer(ServerConfig(worker_id="pool-decode", port=0,
+                                    max_frame_bytes=512 * 1024 * 1024),
+                       engine_factory=factory)
+    ph, pp = await pre.start()
+    dh, dp = await dec.start()
+    await pre.load_model_async(ModelConfig(
+        name="m", architecture=bench.MODEL, max_seq_len=max_seq,
+        metadata={"role": "prefill"}))
+    await dec.load_model_async(ModelConfig(
+        name="m", architecture=bench.MODEL, max_seq_len=max_seq,
+        metadata={"continuous": 1}))
+    ca = WorkerClient(ph, pp, max_frame=512 * 1024 * 1024)
+    cb = WorkerClient(dh, dp, max_frame=512 * 1024 * 1024)
+    log(f"pools up ({bench.MODEL}, int8={bench.QUANT}, bs{n}, prompt "
+        f"{bench.PROMPT_LEN} + {bench.NEW_TOKENS} new): "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    from distributed_inference_engine_tpu.cluster.worker import (
+        request_to_dict,
+    )
+    from distributed_inference_engine_tpu.engine.disagg import (
+        handoff_to_wire,
+    )
+
+    def reqs(seed):
+        return bench._requests(spec, seed, n)
+
+    # ---- warmup/compile both paths, including the per-group batch
+    # buckets the pipelined relay admits (group prefills run at n/4)
+    t0 = time.perf_counter()
+    warm = await ca.prefill("m", reqs(1))
+    await cb.call("generate_prefilled", model="m",
+                  requests=[request_to_dict(r) for r in reqs(1)],
+                  handoffs=[handoff_to_wire(h) for h in warm],
+                  timeout=600.0)
+    await cb.generate("m", reqs(2), timeout=600.0)
+    for pg in (1, 4):
+        short = reqs(3)
+        for r in short:
+            r.max_new_tokens = 2
+        await ca.call("prefill_generate", model="m",
+                      requests=[request_to_dict(r) for r in short],
+                      decode_host=dh, decode_port=dp, peer_timeout=600.0,
+                      pipeline_groups=pg, timeout=600.0)
+    log(f"warmup (compile both pools): {time.perf_counter() - t0:.1f}s")
+
+    # ---- 1) prefill + handoff transfer (client-observed, loopback frame)
+    t0 = time.perf_counter()
+    handoffs = await ca.prefill("m", reqs(10))
+    t_prefill_ship = time.perf_counter() - t0
+    kv_bytes = sum(h.k.nbytes + h.v.nbytes for h in handoffs)
+
+    # ---- 2) decode-pool admission of handed-off KV (2 tokens)
+    short = reqs(10)
+    for r in short:
+        r.max_new_tokens = 2
+    t0 = time.perf_counter()
+    await cb.call("generate_prefilled", model="m",
+                  requests=[request_to_dict(r) for r in short],
+                  handoffs=[handoff_to_wire(h) for h in handoffs],
+                  timeout=600.0)
+    t_admit = time.perf_counter() - t0
+
+    # ---- 3) relay end-to-end vs single-pool, same engine, same requests.
+    # pipeline_groups=1: monolithic (prefill all -> ship all -> decode);
+    # =4: group g+1 prefills while group g's KV is in flight and decoding
+    t0 = time.perf_counter()
+    out = await ca.call("prefill_generate", model="m",
+                        requests=[request_to_dict(r) for r in reqs(20)],
+                        decode_host=dh, decode_port=dp, peer_timeout=600.0,
+                        pipeline_groups=1, timeout=600.0)
+    t_mono = time.perf_counter() - t0
+    toks_mono = sum(len(r["tokens"]) for r in out["results"])
+
+    t0 = time.perf_counter()
+    out = await ca.call("prefill_generate", model="m",
+                        requests=[request_to_dict(r) for r in reqs(21)],
+                        decode_host=dh, decode_port=dp, peer_timeout=600.0,
+                        pipeline_groups=4, timeout=600.0)
+    t_disagg = time.perf_counter() - t0
+    toks_disagg = sum(len(r["tokens"]) for r in out["results"])
+
+    t0 = time.perf_counter()
+    res_single = await cb.generate("m", reqs(30), timeout=600.0)
+    t_single = time.perf_counter() - t0
+    toks_single = sum(len(r.tokens) for r in res_single)
+
+    row = {
+        "metric": f"disagg_{bench.MODEL}{'_int8' if bench.QUANT else ''}"
+                  f"_bs{n}_p{bench.PROMPT_LEN}",
+        "kv_handoff_mb_per_req": round(kv_bytes / n / 1e6, 2),
+        "prefill_ship_s": round(t_prefill_ship, 2),
+        "admit_s": round(t_admit, 2),
+        "disagg_mono_e2e_s": round(t_mono, 2),
+        "disagg_pipe4_e2e_s": round(t_disagg, 2),
+        "single_e2e_s": round(t_single, 2),
+        "disagg_tok_s": round(toks_disagg / t_disagg, 1),
+        "single_tok_s": round(toks_single / t_single, 1),
+        "pipeline_gain_pct": round(100 * (t_mono - t_disagg) / t_mono, 1),
+        "overhead_vs_single_pct": round(
+            100 * (t_disagg - t_single) / t_single, 1),
+    }
+    assert toks_mono > 0 and toks_disagg > 0 and toks_single > 0
+    print(json.dumps(row), flush=True)
+    await ca.close()
+    await cb.close()
+    await pre.stop()
+    await dec.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,141 @@
+"""Latency-throughput sweep: Poisson load against one continuous engine at
+several offered rates (VERDICT r2 item 2's measurement half).
+
+Builds the engine ONCE (8B-scale init costs minutes on a tunnelled chip),
+then for each offered rate runs an independent Poisson arrival trial and
+reports goodput, TTFT p50/p99, ITL p99, occupancy, and rejections. With
+overload handling on (queue cap + deadline shed), past-saturation rates
+show a knee — bounded p99 with explicit rejections — instead of unbounded
+queue growth.
+
+Usage (defaults mirror bench.py serving mode at the 8B rung):
+    python examples/serving_sweep.py
+    SWEEP_RATES=4,8,12 SWEEP_REQUESTS=96 python examples/serving_sweep.py
+Prints one JSON line per rate and a final markdown table on stderr.
+"""
+
+import asyncio
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402  (repo-root bench.py: engine/request builders)
+from distributed_inference_engine_tpu.engine.types import (  # noqa: E402
+    EngineOverloadedError,
+)
+from distributed_inference_engine_tpu.serving.pump import EnginePump  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def pct(xs, q):
+    return (sorted(xs)[min(len(xs) - 1, math.ceil(q * len(xs)) - 1)]
+            if xs else 0.0)
+
+
+async def run_rate(pump, spec, rate, n_requests, seed):
+    engine = pump.engine
+    ttfts, itls = [], []
+    rejected = [0]
+    reqs = bench._requests(spec, seed, n_requests)
+    m0 = engine.get_metrics()
+    steps0 = m0["engine_steps"]
+    occ0 = m0["batch_occupancy"] * steps0 * engine.max_slots
+
+    async def client(req):
+        marks = []
+
+        def on_tokens(toks):
+            marks.append((time.perf_counter(), len(toks)))
+
+        try:
+            res = await pump.generate_streaming(req, on_tokens)
+        except EngineOverloadedError:
+            rejected[0] += 1
+            return 0
+        ttfts.append(res.ttft_s)
+        prev = None
+        for t, k in marks:
+            if prev is not None:
+                itls.append(t - prev)
+                itls.extend([0.0] * (k - 1))
+            prev = t
+        return len(res.tokens)
+
+    rs = np.random.RandomState(seed)
+    tasks = []
+    t_start = time.perf_counter()
+    for req in reqs:
+        tasks.append(asyncio.create_task(client(req)))
+        await asyncio.sleep(float(rs.exponential(1.0 / rate)))
+    counts = await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+    m = engine.get_metrics()
+    d_steps = m["engine_steps"] - steps0
+    occ = ((m["batch_occupancy"] * m["engine_steps"] * engine.max_slots
+            - occ0) / (d_steps * engine.max_slots)) if d_steps else 0.0
+    return {
+        "rate": rate,
+        "goodput_toks": round(sum(counts) / wall, 1),
+        "served": len(reqs) - rejected[0],
+        "rejected": rejected[0],
+        "rejection_rate": round(rejected[0] / len(reqs), 3),
+        "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 1),
+        "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
+        "occupancy": round(occ, 3),
+        "wall_s": round(wall, 1),
+    }
+
+
+def main():
+    spec = bench._spec()
+    rates = [float(r) for r in os.environ.get(
+        "SWEEP_RATES", "4,8,12,16,24").split(",")]
+    n_requests = int(os.environ.get("SWEEP_REQUESTS", "96"))
+    steps = int(os.environ.get("BENCH_STEPS", "16"))
+
+    t0 = time.perf_counter()
+    params = bench._build_params(spec, bench.QUANT)
+    engine = bench._engine(spec, params, "continuous", bench.BATCH, steps)
+    engine.config.max_waiting = int(
+        os.environ.get("BENCH_MAX_WAITING", str(bench.BATCH)))
+    engine.config.queue_deadline_s = float(
+        os.environ.get("BENCH_DEADLINE_S", "8"))
+    log(f"engine init ({bench.MODEL}, bs{bench.BATCH}, int8={bench.QUANT}, "
+        f"max_waiting={engine.config.max_waiting}, "
+        f"deadline={engine.config.queue_deadline_s}s): "
+        f"{time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    engine.warmup(max_new_tokens=2)
+    log(f"warmup (all buckets): {time.perf_counter() - t0:.1f}s")
+
+    pump = EnginePump(engine, idle_wait_s=0.01)
+    rows = []
+    for i, rate in enumerate(rates):
+        row = asyncio.run(run_rate(pump, spec, rate, n_requests, 100 + i))
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    asyncio.run(pump.stop())
+
+    log("\n| offered req/s | goodput tok/s | served | rejected | TTFT p50 | "
+        "TTFT p99 | ITL p99 | occupancy |")
+    log("|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        log(f"| {r['rate']:g} | {r['goodput_toks']} | {r['served']} | "
+            f"{r['rejected']} ({r['rejection_rate']:.0%}) | "
+            f"{r['ttft_p50_ms']:.0f} ms | {r['ttft_p99_ms']:.0f} ms | "
+            f"{r['itl_p99_ms']:.1f} ms | {r['occupancy']:.2f} |")
+
+
+if __name__ == "__main__":
+    main()

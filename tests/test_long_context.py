@@ -170,29 +170,17 @@ def test_sliding_window_engine_serves_under_sp():
     t_pl = plain.generate(req())[0].tokens
     assert t_sp[0] == t_pl[0]          # chains may flip on fp near-ties
     assert len(t_sp) == len(t_pl) == 5
-    # FULL-CHAIN check (same scheme as __graft_entry__'s sp-decode
-    # verification): teacher-force the sp chain through the unsharded
-    # forward — every sp token must be the unsharded argmax given the
-    # same prefix, skipping only fp near-ties. Catches window-mask bugs
-    # that surface mid-decode (e.g. once the generated length crosses a
-    # block or window boundary), which a first-token check cannot.
-    from distributed_inference_engine_tpu.models.base import forward_train
+    # FULL-CHAIN check, shared with __graft_entry__'s sp-decode
+    # verification (utils/parity.py): teacher-forced margin-aware argmax
+    # comparison — catches window-mask bugs that surface mid-decode (e.g.
+    # once the generated length crosses a block or window boundary),
+    # which a first-token check cannot.
+    from distributed_inference_engine_tpu.utils.parity import (
+        assert_greedy_parity,
+    )
 
-    seq = jnp.asarray([prompt + t_sp], jnp.int32)
-    logits = np.asarray(forward_train(
-        wspec, plain.params, seq,
-        jnp.full((1,), seq.shape[1], jnp.int32)))[0]
-    matched = 0
-    for i, tok in enumerate(t_sp):
-        lg = logits[len(prompt) - 1 + i]
-        top2 = np.sort(lg)[-2:]
-        if float(top2[1] - top2[0]) < 5e-3:
-            continue                               # fp near-tie: skip
-        assert int(lg.argmax()) == tok, (
-            f"sp sliding-window decode step {i}: got {tok}, unsharded "
-            f"argmax {int(lg.argmax())}")
-        matched += 1
-    assert matched >= 3, f"only {matched}/5 non-tie steps verified"
+    assert_greedy_parity(wspec, plain.params, prompt, t_sp,
+                         label="sp sliding-window decode")
 
 
 def test_sp_decode_cache_stays_sequence_sharded():
