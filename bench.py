@@ -66,6 +66,12 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def pct(xs, q: float):
+    """Nearest-rank percentile (shared with examples/serving_sweep.py)."""
+    return (sorted(xs)[min(len(xs) - 1, math.ceil(q * len(xs)) - 1)]
+            if xs else 0.0)
+
+
 def _probe_tpu(timeout_s: float = 120.0) -> bool:
     """Device discovery over a tunnelled TPU plugin can hang indefinitely
     when the tunnel is down; probe it in a throwaway subprocess so the
@@ -354,9 +360,6 @@ def serving_main() -> None:
 
     total_toks, wall = asyncio.run(run())
     m = engine.get_metrics()
-    pct = lambda xs, q: (sorted(xs)[min(len(xs) - 1,
-                                        math.ceil(q * len(xs)) - 1)]
-                         if xs else 0.0)
     toks_per_s = total_toks / wall
     ttft_p50, ttft_p99 = pct(ttfts, 0.5) * 1e3, pct(ttfts, 0.99) * 1e3
     itl_p99 = pct(itls, 0.99) * 1e3

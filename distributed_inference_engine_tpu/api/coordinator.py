@@ -54,11 +54,15 @@ logger = logging.getLogger(__name__)
 
 
 def _shed_reason(exc) -> str:
-    """Best-effort recovery of the shed reason from a relayed overloaded
-    RPC error: the engine's structured ``reason`` doesn't survive the wire
-    (only ``error_kind`` + message text do), but both shed messages name
-    their cause — clients distinguish "queue_full" (retry elsewhere now)
-    from "deadline" (the request aged out; shorten timeouts)."""
+    """Shed reason of a relayed overloaded RPC error: structurally from
+    the envelope's ``error_detail`` (``RPCError.detail``, carried from the
+    engine's ``rpc_error_detail``), with a message-text fallback for peers
+    predating the field — clients distinguish "queue_full" (retry
+    elsewhere now) from "deadline" (the request aged out; shorten
+    timeouts)."""
+    detail = getattr(exc, "detail", "")
+    if detail:
+        return detail
     return "deadline" if "deadline" in str(exc) else "queue_full"
 
 # transport-level trouble ⇒ health signal + retry; application errors

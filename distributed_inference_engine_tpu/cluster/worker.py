@@ -191,6 +191,9 @@ class WorkerServer(FramedServerMixin):
         self._request_count = 0
         self._error_count = 0
         self._overloaded_count = 0     # load sheds, apart from real errors
+        self._handoff_bytes_shipped = 0  # relay KV actually sent (deltas
+                                         # make this < prefill engine's
+                                         # total_handoff_bytes)
         self._ping_count = 0
         self._active_connections = 0
         self.latency = LatencyStats()
@@ -200,6 +203,7 @@ class WorkerServer(FramedServerMixin):
             "prefill": self._rpc_prefill,
             "generate_prefilled": self._rpc_generate_prefilled,
             "prefill_generate": self._rpc_prefill_generate,
+            "prefix_probe": self._rpc_prefix_probe,
             "load_model": self._rpc_load_model,
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
@@ -492,6 +496,26 @@ class WorkerServer(FramedServerMixin):
         return {"model": name,
                 "handoffs": [handoff_to_wire(h) for h in handoffs]}
 
+    async def _rpc_prefix_probe(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Decode-pool op: how many leading prompt tokens (page-aligned)
+        does this engine's prefix cache already hold, per prompt? The
+        disaggregated prefill worker uses the answer to ship delta
+        handoffs (KV tail only). Advisory — admission re-checks and a
+        shortfall surfaces as the typed ``stale_prefix`` result."""
+        from ..engine.paged_kv import page_chain_hashes
+
+        name, engine = self._engine_for(msg, "submit_prefilled")
+        kv = getattr(engine, "kv", None)
+        out: List[int] = []
+        for prompt in msg.get("prompts", []):
+            if kv is None or not getattr(engine, "prefix_cache", False):
+                out.append(0)
+                continue
+            matchable = (len(prompt) - 1) // kv.page_size
+            hashes = page_chain_hashes(prompt, matchable, kv.page_size)
+            out.append(kv.probe_prefix(hashes) * kv.page_size)
+        return {"model": name, "cached_tokens": out}
+
     async def _rpc_generate_prefilled(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Decode-pool op: admit handed-off KV, decode to completion."""
         from ..engine.disagg import handoff_from_wire
@@ -588,6 +612,29 @@ class WorkerServer(FramedServerMixin):
             handoffs = await loop.run_in_executor(
                 self._executor, engine.prefill, [reqs[i] for i in g_idxs]
             )
+            # prefix-aware delta handoff: probe which page-aligned prompt
+            # heads the decode pool's prefix cache already holds and ship
+            # only the KV tails. The probe is advisory — a reclaimed page
+            # surfaces as a typed per-request stale_prefix result below,
+            # answered by re-shipping that request's full KV.
+            from ..engine.disagg import trim_handoff
+
+            full_handoffs = handoffs             # kept for stale re-sends
+            try:
+                probe = await peer.call(
+                    "prefix_probe", model=decode_model,
+                    prompts=[list(reqs[i].prompt[-h.prompt_len:])
+                             for i, h in zip(g_idxs, handoffs)],
+                    timeout=peer_timeout,
+                )
+                cached = probe.get("cached_tokens", [])
+            except RPCError:
+                cached = []                      # peer predates the probe op
+            cached = cached + [0] * (len(handoffs) - len(cached))
+            # probe counts are page-aligned and capped below prompt_len by
+            # construction ((len-1)//P pages) — the guard is belt/braces
+            handoffs = [trim_handoff(h, c) if 0 < c < h.prompt_len else h
+                        for h, c in zip(handoffs, cached)]
             # KV handoffs are big (≈2·L·Hkv·Dh·itemsize bytes/token) —
             # pack into as many generate_prefilled frames as the limit
             # needs. An oversize SINGLE handoff is a config error (raise
@@ -595,14 +642,14 @@ class WorkerServer(FramedServerMixin):
             # dent the healthy decode worker's health on every long prompt
             wires = [handoff_to_wire(h) for h in handoffs]
             sizes = [len(w["k"]) + len(w["v"]) + 4096 for w in wires]
-            for h, s in zip(handoffs, sizes):
-                if s > budget:
-                    raise ValueError(
-                        f"handoff for request {h.request_id!r} is {s} "
-                        f"bytes — exceeds the "
-                        f"{self.config.max_frame_bytes}-byte frame limit; "
-                        "raise ServerConfig.max_frame_bytes on both pools"
-                    )
+            self._handoff_bytes_shipped += sum(
+                len(w["k"]) + len(w["v"]) for w in wires)
+            # the up-front prompt-length estimate already bounds every
+            # wire (trimming only shrinks them) — a violation here would
+            # be an accounting bug, and raising mid-pipeline would orphan
+            # shipped groups, so assert rather than raise
+            assert all(s <= budget for s in sizes), \
+                "handoff wire exceeded the up-front size bound"
             frames: List[List[int]] = []
             cur: List[int] = []
             cur_bytes = 0
@@ -629,6 +676,23 @@ class WorkerServer(FramedServerMixin):
             for js, part in zip(frames, parts):
                 for j, r in zip(js, part["results"]):
                     out[j] = r
+            # a delta handoff can lose its race (prefix pages reclaimed
+            # between probe and admission): re-ship those requests' FULL
+            # KV, one call each — the rare path buys simplicity
+            stale = [j for j, r in enumerate(out)
+                     if isinstance(r, dict)
+                     and r.get("finish_reason") == "stale_prefix"]
+            for j in stale:
+                full_wire = handoff_to_wire(full_handoffs[j])
+                self._handoff_bytes_shipped += (len(full_wire["k"])
+                                                + len(full_wire["v"]))
+                retry = await peer.call(
+                    "generate_prefilled", model=decode_model,
+                    requests=[reqs_wire[g_idxs[j]]],
+                    handoffs=[full_wire],
+                    timeout=peer_timeout,
+                )
+                out[j] = retry["results"][0]
             return out
 
         tasks = [asyncio.ensure_future(run_group(g)) for g in groups]
@@ -695,6 +759,7 @@ class WorkerServer(FramedServerMixin):
             "request_count": self._request_count,
             "error_count": self._error_count,
             "overloaded_count": self._overloaded_count,
+            "handoff_bytes_shipped": self._handoff_bytes_shipped,
             "ping_count": self._ping_count,          # probes counted apart
             "active_connections": self._active_connections,
             "latency": self.latency.snapshot(),

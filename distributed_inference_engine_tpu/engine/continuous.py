@@ -530,11 +530,18 @@ class ContinuousEngine:
                 f"(L={self.spec.n_layers}, Hkv={self.spec.n_kv_heads}, "
                 f"Dh={self.spec.head_dim})"
             )
-        if T != handoff.prompt_len or T < 1 or T >= self.max_seq_len:
+        pl = handoff.prompt_len
+        if (T != pl - handoff.kv_start or pl < 1 or pl >= self.max_seq_len
+                or not 0 <= handoff.kv_start < pl):
             raise ValueError(
-                f"handoff prompt_len {handoff.prompt_len} / KV T {T} invalid "
-                f"for max_seq_len {self.max_seq_len}"
+                f"handoff prompt_len {pl} / kv_start {handoff.kv_start} / "
+                f"KV T {T} inconsistent or beyond max_seq_len "
+                f"{self.max_seq_len}"
             )
+        if handoff.kv_start and not self.prefix_cache:
+            raise ValueError(
+                "delta handoff (kv_start > 0) needs the decode engine's "
+                "prefix cache enabled")
         self._check_admission_cap()
         self._total_requests += 1
         if not request.request_id:
@@ -593,33 +600,75 @@ class ContinuousEngine:
 
     def _admit_prefilled(self) -> int:
         """Admit handed-off sequences: write their KV into pages, no local
-        prefill program — the disaggregated half of ``_try_admit``."""
+        prefill program — the disaggregated half of ``_try_admit``.
+
+        Prefix-aware: with the prefix cache on, admission allocates via
+        ``alloc_slot_prefix`` so cached prompt-head pages are REUSED (and
+        a delta handoff — ``kv_start > 0`` — only ships/writes the tail).
+        The probe that trimmed the handoff was advisory; if the cached
+        prefix shrank in flight (pages reclaimed), the request resolves
+        with the typed ``stale_prefix`` outcome and the sender re-ships
+        full KV. Admitted prompts register their pages, so disaggregated
+        traffic fills the decode pool's prefix cache exactly like local
+        admissions do."""
         admitted = 0
         while self._waiting_prefilled:
             req, handoff, on_tok, t_submit = self._waiting_prefilled[0]
             prompt_len = handoff.prompt_len
-            slot = self.kv.alloc_slot(prompt_len)
-            if slot is None:
-                self._admission_denied += 1
-                break
+            # the tokens the prefill pool actually ran (it tail-truncates
+            # overlong prompts exactly like submit())
+            tok = req.prompt[-prompt_len:]
+            n_cached = 0
+            if self.prefix_cache:
+                got = self.kv.alloc_slot_prefix(tok)
+                if got is None:
+                    self._admission_denied += 1
+                    break
+                slot, n_cached = got
+                if n_cached < handoff.kv_start:
+                    # advisory probe went stale: the handoff lacks KV for
+                    # [n_cached, kv_start) — typed outcome, sender retries
+                    # with the full payload
+                    self.kv.free_slot(slot)
+                    self._waiting_prefilled.popleft()
+                    self._finished.append(GenerationResult(
+                        request_id=req.request_id, tokens=[],
+                        finish_reason="stale_prefix",
+                        prompt_tokens=prompt_len,
+                        metadata={"kv_start": handoff.kv_start,
+                                  "cached_now": n_cached}))
+                    continue
+            else:
+                slot = self.kv.alloc_slot(prompt_len)
+                if slot is None:
+                    self._admission_denied += 1
+                    break
             self._waiting_prefilled.popleft()
             admitted += 1
             t0 = time.perf_counter()
-            # pad T to a prefill bucket so the scatter reuses the same
-            # compiled shapes as local admission
-            tb = _next_bucket(prompt_len, self.prefill_buckets)
+            # write only [n_cached, prompt_len) — the cached head pages are
+            # shared; pad the tail to a prefill bucket so the scatter
+            # reuses the same compiled shapes as local admission
+            tail = prompt_len - n_cached
+            off = n_cached - handoff.kv_start   # offset into handoff rows
+            tb = _next_bucket(tail, self.prefill_buckets)
             L, _, Hkv, Dh = handoff.k.shape
             ks = np.zeros((L, 1, tb, Hkv, Dh), dtype=handoff.k.dtype)
             vs = np.zeros_like(ks)
-            ks[:, 0, :prompt_len] = handoff.k
-            vs[:, 0, :prompt_len] = handoff.v
-            seq_lens = jnp.asarray([prompt_len], jnp.int32)
+            ks[:, 0, :tail] = handoff.k[:, off:]
+            vs[:, 0, :tail] = handoff.v[:, off:]
             kp, vp = self._write_pages(
                 self.kv.k_pages, self.kv.v_pages,
                 jnp.asarray(ks), jnp.asarray(vs),
-                self.kv.page_table[slot: slot + 1], seq_lens,
+                self.kv.page_table[slot: slot + 1],
+                jnp.asarray([tail], jnp.int32),
+                start=jnp.asarray([n_cached], jnp.int32),
             )
             self.kv.swap(kp, vp)
+            if self.prefix_cache:
+                self.kv.register_prefix(slot, tok)
+                if n_cached:
+                    self._prefix_hit_admissions += 1
             self._total_prompt_tokens += prompt_len
             self._install_slot(req, slot, prompt_len, handoff.first_token,
                                t0, on_tok, t_submit=t_submit,

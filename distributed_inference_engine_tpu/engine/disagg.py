@@ -54,10 +54,14 @@ from .types import GenerationRequest
 class PrefillHandoff:
     """Everything a decode worker needs to resume a prefilled sequence.
 
-    ``k``/``v`` are ``[L, T, Hkv, Dh]`` numpy arrays (T = true prompt length,
-    no padding) in the KV-cache dtype; ``first_token`` was sampled from the
-    prefill logits with the request's own sampling params, so the decode
-    side starts at position T with ``produced == 1``.
+    ``k``/``v`` are ``[L, T - kv_start, Hkv, Dh]`` numpy arrays in the
+    KV-cache dtype: positions ``[kv_start, prompt_len)`` of the prompt
+    (``kv_start`` is 0 for a full handoff — the common case). A nonzero
+    ``kv_start`` is the prefix-aware delta handoff: the sender probed the
+    decode pool's prefix cache (``WorkerServer._rpc_prefix_probe``) and
+    omitted the page-aligned head the pool already holds. ``first_token``
+    was sampled from the prefill logits with the request's own sampling
+    params, so the decode side starts at position T with ``produced == 1``.
     """
 
     request_id: str
@@ -66,9 +70,24 @@ class PrefillHandoff:
     k: np.ndarray
     v: np.ndarray
     first_logprob: float = 0.0       # untempered log p of first_token
+    kv_start: int = 0                # prompt positions [0, kv_start) omitted
 
     def nbytes(self) -> int:
         return self.k.nbytes + self.v.nbytes
+
+
+def trim_handoff(h: PrefillHandoff, kv_start: int) -> PrefillHandoff:
+    """Delta form of ``h``: drop the KV for positions < ``kv_start`` (which
+    the receiver's prefix cache already holds). No-op for kv_start <= 0."""
+    if kv_start <= 0:
+        return h
+    if not 0 < kv_start < h.prompt_len:
+        raise ValueError(
+            f"kv_start {kv_start} out of range for prompt_len {h.prompt_len}")
+    if h.kv_start:
+        raise ValueError("handoff is already trimmed")
+    return dataclasses.replace(
+        h, k=h.k[:, kv_start:], v=h.v[:, kv_start:], kv_start=kv_start)
 
 
 def handoff_to_wire(h: PrefillHandoff) -> Dict[str, Any]:
@@ -78,6 +97,7 @@ def handoff_to_wire(h: PrefillHandoff) -> Dict[str, Any]:
         "prompt_len": h.prompt_len,
         "first_token": h.first_token,
         "first_logprob": h.first_logprob,
+        "kv_start": h.kv_start,
         "dtype": jnp.dtype(h.k.dtype).name,
         "shape": list(h.k.shape),
         "k": h.k.tobytes(),
@@ -101,6 +121,7 @@ def handoff_from_wire(d: Dict[str, Any]) -> PrefillHandoff:
         prompt_len=int(d["prompt_len"]),
         first_token=int(d["first_token"]),
         first_logprob=float(d.get("first_logprob", 0.0)),
+        kv_start=int(d.get("kv_start", 0)),
         k=_arr(d["k"]),
         v=_arr(d["v"]),
     )

@@ -42,6 +42,24 @@ class OutOfPagesError(RuntimeError):
     """Pool exhausted — the scheduler must queue or preempt."""
 
 
+def page_chain_hashes(tokens, n_pages: int, page_size: int) -> List[bytes]:
+    """Chain hashes for the first ``n_pages`` FULL pages of ``tokens``:
+    hash_i commits to tokens[0 : (i+1)·P], so a hit is an exact-prefix
+    match, never a content collision across different prefixes.
+
+    Module-level so a REMOTE party (the disaggregated prefill worker) can
+    compute the same chain and probe a decode pool's prefix cache without
+    shipping the prompt twice (``WorkerServer._rpc_prefix_probe``)."""
+    out: List[bytes] = []
+    h = b""
+    for i in range(n_pages):
+        chunk = np.asarray(tokens[i * page_size: (i + 1) * page_size],
+                           np.int64).tobytes()
+        h = hashlib.blake2b(h + chunk, digest_size=16).digest()
+        out.append(h)
+    return out
+
+
 class PagedKVCache:
     """Host-side page allocator + device-side page pool for one model."""
 
@@ -244,17 +262,20 @@ class PagedKVCache:
     # ----------------------------------------------------- prefix caching
 
     def _page_hashes(self, tokens, n_pages: int) -> List[bytes]:
-        """Chain hashes for the first ``n_pages`` FULL pages of ``tokens``:
-        hash_i commits to tokens[0 : (i+1)·P], so a hit is an exact-prefix
-        match, never a content collision across different prefixes."""
-        out: List[bytes] = []
-        h = b""
-        P = self.page_size
-        for i in range(n_pages):
-            chunk = np.asarray(tokens[i * P: (i + 1) * P], np.int64).tobytes()
-            h = hashlib.blake2b(h + chunk, digest_size=16).digest()
-            out.append(h)
-        return out
+        return page_chain_hashes(tokens, n_pages, self.page_size)
+
+    def probe_prefix(self, hashes: List[bytes]) -> int:
+        """How many LEADING chain hashes are currently indexed — the page
+        count a prefix-aware handoff may omit. Advisory: pages can be
+        reclaimed between probe and admission; ``alloc_slot_prefix`` at
+        admission is authoritative and a shortfall surfaces as the typed
+        ``stale_prefix`` outcome (the sender re-ships the full KV)."""
+        n = 0
+        for h in hashes:
+            if h not in self._prefix_index:
+                break
+            n += 1
+        return n
 
     def first_page_hash(self, tokens,
                         registerable: bool = False) -> Optional[bytes]:

@@ -29,6 +29,9 @@ class EngineOverloadedError(RuntimeError):
     def __init__(self, msg: str, reason: str = "queue_full") -> None:
         super().__init__(msg)
         self.reason = reason            # "queue_full" | "deadline"
+        # rides the RPC error envelope as ``error_detail`` so remote
+        # callers get the reason structurally, not by sniffing text
+        self.rpc_error_detail = reason
 
 
 @dataclass

@@ -26,12 +26,16 @@ class RPCError(RuntimeError):
     envelope's ``error_kind``, from the handler exception's
     ``rpc_error_kind`` attribute) so callers can react to specific
     failures — e.g. a relay's unreachable decode peer — without sniffing
-    error text.
+    error text. ``detail`` is the optional machine-readable sub-reason
+    (envelope ``error_detail``, from ``rpc_error_detail``) — e.g. an
+    overloaded worker's "queue_full" vs "deadline".
     """
 
-    def __init__(self, message: str, kind: str = "") -> None:
+    def __init__(self, message: str, kind: str = "",
+                 detail: str = "") -> None:
         super().__init__(message)
         self.kind = kind
+        self.detail = detail
 
 
 class FramedRPCClient:
@@ -210,7 +214,8 @@ class FramedRPCClient:
             raise RPCError(f"malformed response: {response!r}")
         if not response.get("success"):
             raise RPCError(response.get("error", "unknown peer error"),
-                           kind=str(response.get("error_kind", "")))
+                           kind=str(response.get("error_kind", "")),
+                           detail=str(response.get("error_detail", "")))
         return response.get("result")
 
 
@@ -338,6 +343,10 @@ class FramedServerMixin:
             kind = getattr(e, "rpc_error_kind", "") or getattr(e, "kind", "")
             if kind:
                 response["error_kind"] = kind
+            detail = (getattr(e, "rpc_error_detail", "")
+                      or getattr(e, "detail", ""))
+            if detail:
+                response["error_detail"] = detail
         self._after_dispatch(method, req_id, time.perf_counter() - t0,
                              response)
         return response
@@ -381,6 +390,10 @@ class FramedServerMixin:
             kind = getattr(e, "rpc_error_kind", "") or getattr(e, "kind", "")
             if kind:
                 response["error_kind"] = kind
+            detail = (getattr(e, "rpc_error_detail", "")
+                      or getattr(e, "detail", ""))
+            if detail:
+                response["error_detail"] = detail
         self._after_dispatch(method, req_id, time.perf_counter() - t0,
                              response)
         return response

@@ -160,6 +160,20 @@ async def main():
     t_single = time.perf_counter() - t0
     toks_single = sum(len(r.tokens) for r in res_single)
 
+    # ---- 4) prefix-aware delta handoff: repeat the SAME prompts — the
+    # decode pool's prefix cache holds their full pages, so the relay
+    # ships only each prompt's final partial page
+    shipped0 = (await ca.call("metrics"))["handoff_bytes_shipped"]
+    t0 = time.perf_counter()
+    out = await ca.call("prefill_generate", model="m",
+                        requests=[request_to_dict(r) for r in reqs(21)],
+                        decode_host=dh, decode_port=dp, peer_timeout=600.0,
+                        timeout=600.0)
+    t_delta = time.perf_counter() - t0
+    toks_delta = sum(len(r["tokens"]) for r in out["results"])
+    shipped_delta = ((await ca.call("metrics"))["handoff_bytes_shipped"]
+                     - shipped0)
+
     row = {
         "metric": f"disagg_{bench.MODEL}{'_int8' if bench.QUANT else ''}"
                   f"_bs{n}_p{bench.PROMPT_LEN}",
@@ -174,8 +188,13 @@ async def main():
         "pipeline_gain_pct": round(100 * (t_mono - t_disagg) / t_mono, 1),
         "overhead_vs_single_pct": round(
             100 * (t_disagg - t_single) / t_single, 1),
+        "delta_repeat_e2e_s": round(t_delta, 2),
+        "delta_shipped_mb_per_req": round(shipped_delta / n / 1e6, 2),
+        "delta_bytes_saved_pct": round(
+            100 * (1 - shipped_delta / max(kv_bytes, 1)), 1),
     }
-    assert toks_mono > 0 and toks_disagg > 0 and toks_single > 0
+    assert (toks_mono > 0 and toks_disagg > 0 and toks_single > 0
+            and toks_delta > 0)
     print(json.dumps(row), flush=True)
     await ca.close()
     await cb.close()

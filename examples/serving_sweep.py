@@ -16,7 +16,6 @@ Prints one JSON line per rate and a final markdown table on stderr.
 
 import asyncio
 import json
-import math
 import os
 import sys
 import time
@@ -27,19 +26,11 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (repo-root bench.py: engine/request builders)
+from bench import log, pct  # noqa: E402
 from distributed_inference_engine_tpu.engine.types import (  # noqa: E402
     EngineOverloadedError,
 )
 from distributed_inference_engine_tpu.serving.pump import EnginePump  # noqa: E402
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
-
-
-def pct(xs, q):
-    return (sorted(xs)[min(len(xs) - 1, math.ceil(q * len(xs)) - 1)]
-            if xs else 0.0)
 
 
 async def run_rate(pump, spec, rate, n_requests, seed):

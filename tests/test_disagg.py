@@ -444,3 +444,143 @@ async def test_coordinator_disagg_prefill_failover():
         await coord.stop()
         for w in workers[1:]:
             await w.stop()
+
+
+# ----------------------------------------------------- prefix-aware handoff
+
+
+def test_probe_and_trim_handoff_roundtrip():
+    """probe_prefix counts indexed leading pages; trim_handoff drops the
+    cached head and the wire form round-trips kv_start."""
+    from distributed_inference_engine_tpu.engine.disagg import trim_handoff
+    from distributed_inference_engine_tpu.engine.paged_kv import (
+        page_chain_hashes,
+    )
+
+    rng = np.random.RandomState(1)
+    k = rng.randn(2, 40, 4, 64).astype("float32")
+    v = rng.randn(2, 40, 4, 64).astype("float32")
+    h = PrefillHandoff(request_id="t", prompt_len=40, first_token=5,
+                       k=k, v=v)
+    t = trim_handoff(h, 32)                 # 2 cached pages of 16
+    assert t.kv_start == 32 and t.k.shape[1] == 8
+    back = handoff_from_wire(handoff_to_wire(t))
+    assert back.kv_start == 32 and back.k.shape[1] == 8
+    np.testing.assert_array_equal(back.k, k[:, 32:])
+    with pytest.raises(ValueError):
+        trim_handoff(h, 40)                 # must leave >= 1 position
+    with pytest.raises(ValueError):
+        trim_handoff(t, 8)                  # already trimmed
+    # hash helper parity with the in-cache hashing
+    de = ContinuousEngine(SPEC, config=_cfg())
+    toks = list(range(1, 40))
+    hs = page_chain_hashes(toks, 2, de.kv.page_size)
+    assert de.kv.probe_prefix(hs) == 0      # nothing registered yet
+
+
+def test_delta_handoff_reuses_cached_prefix_and_matches_full():
+    """Second handoff of a shared-prefix prompt ships only the tail: the
+    decode engine reuses its registered prefix pages, and greedy output
+    is identical to the full-handoff path."""
+    import jax
+
+    from distributed_inference_engine_tpu.engine.disagg import trim_handoff
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(0))
+    # shared 32-token head (2 pages of 16), distinct tails
+    head = list(range(1, 33))
+    r1 = GenerationRequest(prompt=head + [40, 41, 42], max_new_tokens=6,
+                          temperature=0.0, request_id="full")
+    r2 = GenerationRequest(prompt=head + [50, 51], max_new_tokens=6,
+                          temperature=0.0, request_id="delta")
+    pe = PrefillEngine(SPEC, params=params, config=_cfg())
+    de = ContinuousEngine(SPEC, params=params, config=_cfg())
+    ref = ContinuousEngine(SPEC, params=params, config=_cfg())
+
+    h1, h2 = pe.prefill([r1, r2])
+    de.submit_prefilled(r1, h1)             # full handoff registers prefix
+    de.run_until_idle()
+    cached = de.kv.probe_prefix(
+        de.kv._page_hashes(r2.prompt, 2))
+    assert cached == 2                      # both head pages indexed
+    h2_delta = handoff_from_wire(handoff_to_wire(trim_handoff(h2, 32)))
+    de.submit_prefilled(
+        GenerationRequest(prompt=r2.prompt, max_new_tokens=6,
+                          temperature=0.0, request_id="delta"), h2_delta)
+    out = {r.request_id: r.tokens for r in de.run_until_idle()}
+    base = {r.request_id: r.tokens
+            for r in ref.generate([
+                GenerationRequest(prompt=r2.prompt, max_new_tokens=6,
+                                  temperature=0.0, request_id="delta")])}
+    assert out["delta"] == base["delta"]
+    assert de.get_metrics()["kv"]["prefix_hit_tokens"] >= 32
+
+
+def test_stale_delta_handoff_resolves_typed_outcome():
+    """A delta handoff against an engine whose cache lacks the prefix
+    resolves as finish_reason=stale_prefix (sender re-ships full KV)."""
+    import jax
+
+    from distributed_inference_engine_tpu.engine.disagg import trim_handoff
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(0))
+    req = GenerationRequest(prompt=list(range(1, 40)), max_new_tokens=4,
+                            temperature=0.0, request_id="s")
+    pe = PrefillEngine(SPEC, params=params, config=_cfg())
+    de = ContinuousEngine(SPEC, params=params, config=_cfg())
+    (h,) = pe.prefill([req])
+    de.submit_prefilled(req, trim_handoff(h, 16))
+    (res,) = de.run_until_idle()
+    assert res.finish_reason == "stale_prefix"
+    assert res.tokens == [] and res.metadata["kv_start"] == 16
+    # full re-ship then succeeds
+    de.submit_prefilled(
+        GenerationRequest(prompt=req.prompt, max_new_tokens=4,
+                          temperature=0.0, request_id="s2"), h)
+    (res2,) = de.run_until_idle()
+    assert res2.finish_reason in ("length", "stop") and len(res2.tokens) == 4
+
+
+@pytest.mark.asyncio
+async def test_relay_ships_delta_on_repeat_and_recovers_from_stale():
+    """End-to-end over the RPC plane: the relay probes the decode pool,
+    ships delta handoffs for repeated prompts, and the decode engine's
+    prefix-hit counters tick; trimmed-vs-full results stay identical."""
+    wp = WorkerServer(ServerConfig(worker_id="wp2", port=0))
+    wd = WorkerServer(ServerConfig(worker_id="wd2", port=0))
+    await wp.start()
+    await wd.start()
+    try:
+        await wp.load_model_async(_model_cfg(role="prefill"))
+        await wd.load_model_async(_model_cfg(continuous=True))
+        cp = WorkerClient(*wp.address, timeout=120.0)
+        dh, dp = wd.address
+
+        first = await cp.prefill_generate("m", _reqs(), decode_host=dh,
+                                          decode_port=dp)
+        again = await cp.prefill_generate("m", _reqs(), decode_host=dh,
+                                          decode_port=dp)
+        assert {r.request_id: r.tokens for r in first} == \
+            {r.request_id: r.tokens for r in again}
+        m = wd.engines["m"].get_metrics()
+        # prompts are 5 and 3 tokens with page_size 16 — no full page, so
+        # force a page-crossing prompt for the hit
+        long_req = [GenerationRequest(prompt=list(range(1, 40)),
+                                      max_new_tokens=4, temperature=0.0,
+                                      request_id="lp")]
+        await cp.prefill_generate("m", long_req, decode_host=dh,
+                                  decode_port=dp)
+        r2 = await cp.prefill_generate(
+            "m", [GenerationRequest(prompt=list(range(1, 40)),
+                                    max_new_tokens=4, temperature=0.0,
+                                    request_id="lp2")],
+            decode_host=dh, decode_port=dp)
+        assert len(r2) == 1 and len(r2[0].tokens) == 4
+        m = wd.engines["m"].get_metrics()
+        assert m["kv"]["prefix_hit_tokens"] >= 32
+        await cp.close()
+    finally:
+        await wp.stop()
+        await wd.stop()

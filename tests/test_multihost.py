@@ -135,13 +135,20 @@ def test_initialize_multihost_two_real_processes():
             cwd=repo_root)
 
     # the pair must run CONCURRENTLY (initialize blocks until all join);
-    # the 1-process reference rides alongside
+    # the 1-process reference rides alongside. Kill survivors on any
+    # failure — a sibling stuck on the distributed barrier would outlive
+    # the test run holding the port
     procs = [spawn(2, 0), spawn(2, 1), spawn(1, 0)]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, err[-2000:]
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     losses = []
     for out in outs:
         line = [ln for ln in out.splitlines() if ln.startswith("LOSS ")]
