@@ -325,14 +325,18 @@ def paged_attention(
     pools (pallas path; the XLA path's callers slice the layer out — a
     plain gather XLA fuses fine).
 
-    "auto" resolves to the XLA path on every backend: measured on a real
-    v5e at 8B serving shapes (bs64, 256 ctx), the Pallas kernel pays
-    ~13 µs of unhidden DMA latency per (slot, page) grid step — 1.7 ms/
-    layer — while the gather path's extra materialization costs ~1 µs per
-    page and fuses into dense attention (2716 vs 1377 tok/s end-to-end).
-    The kernel stays available explicitly (``EngineConfig
-    .attention_impl="pallas"``) and wins only if its grid is re-blocked
-    to amortize that latency (multi-page DMAs) — future work."""
+    "auto" resolves to the XLA path on every backend — a measured, now
+    settled decision (README "Pallas status"): on a real v5e at 8B
+    serving shapes the kernel's (slot, page) grid pays ~13 µs of
+    unhidden DMA latency per step (1,380 vs 3,623 tok/s end-to-end,
+    round 3), and the dense-ctx chunk scheme (engine/continuous.py)
+    removed the per-step paged read it was built to accelerate — decode
+    now touches pages once per chunk, which stock XLA gathers at full
+    bandwidth. The kernel is RETIRED to a reference/testing role: it
+    stays correct (interpret-mode cross-checks on CPU, explicit
+    ``attention_impl="pallas"``) and is the starting point should a
+    future shape — very long contexts where live-bucket padding waste
+    overtakes DMA latency — reopen the question."""
     if impl == "auto":
         impl = "xla"
     if impl == "xla":

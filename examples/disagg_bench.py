@@ -71,10 +71,10 @@ async def main():
         return ContinuousEngine(spec, params=params, config=ecfg)
 
     pre = WorkerServer(ServerConfig(worker_id="pool-prefill", port=0,
-                                    max_frame_bytes=512 * 1024 * 1024),
+                                    max_frame_bytes=2 * 1024 * 1024 * 1024),
                        engine_factory=factory)
     dec = WorkerServer(ServerConfig(worker_id="pool-decode", port=0,
-                                    max_frame_bytes=512 * 1024 * 1024),
+                                    max_frame_bytes=2 * 1024 * 1024 * 1024),
                        engine_factory=factory)
     ph, pp = await pre.start()
     dh, dp = await dec.start()
@@ -84,8 +84,10 @@ async def main():
     await dec.load_model_async(ModelConfig(
         name="m", architecture=bench.MODEL, max_seq_len=max_seq,
         metadata={"continuous": 1}))
-    ca = WorkerClient(ph, pp, max_frame=512 * 1024 * 1024)
-    cb = WorkerClient(dh, dp, max_frame=512 * 1024 * 1024)
+    # 8B-scale first-compile of a 512-token prefill bucket takes minutes on
+    # a tunnelled chip — the default RPC timeout is for serving, not warmup
+    ca = WorkerClient(ph, pp, max_frame=2 * 1024 * 1024 * 1024, timeout=600.0)
+    cb = WorkerClient(dh, dp, max_frame=2 * 1024 * 1024 * 1024, timeout=600.0)
     log(f"pools up ({bench.MODEL}, int8={bench.QUANT}, bs{n}, prompt "
         f"{bench.PROMPT_LEN} + {bench.NEW_TOKENS} new): "
         f"{time.perf_counter() - t0:.1f}s")

@@ -62,6 +62,9 @@ _TWO_PROC_WORKER = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# the pair compiles IDENTICAL programs: a shared persistent cache makes the
+# second process (and every suite re-run) hit instead of recompiling
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_mh_test")
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
@@ -84,8 +87,8 @@ if nproc > 1:
 assert jax.device_count() == 4 * nproc
 
 spec = llama_spec("llama-tiny", max_seq_len=32, n_layers=2, n_heads=4,
-                  n_kv_heads=4, d_model=128, d_ff=128).replace(
-                      dtype="float32")
+                  n_kv_heads=4, d_model=128, d_ff=128,
+                  vocab_size=512).replace(dtype="float32")
 mesh = global_mesh(MeshConfig(dp=nproc, tp=4))
 assert mesh.devices.size == 4 * nproc
 sh = ModelShardings.build(spec, mesh)
