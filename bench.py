@@ -119,6 +119,11 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
         cfg.kv_dtype = os.environ["BENCH_KV_DTYPE"]
     if os.environ.get("BENCH_ATTN"):
         cfg.attention_impl = os.environ["BENCH_ATTN"]
+    if os.environ.get("BENCH_DEFER"):
+        # overlap each chunk's packed readback with the next chunk's
+        # execution (serving-mode lever: the round trip is ~100 ms on a
+        # tunnelled chip vs a ~300 ms 16-step chunk)
+        cfg.defer_sync = True
     if kind == "static":
         from distributed_inference_engine_tpu.engine.engine import Engine
 

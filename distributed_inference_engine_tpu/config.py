@@ -112,6 +112,17 @@ class EngineConfig:
                                        # this prefill in chunks interleaved with
                                        # decode (0 = whole-prompt prefill);
                                        # rounded to a multiple of page_size
+    defer_sync: bool = False           # continuous engine: dispatch chunk
+                                       # k+1 BEFORE the blocking read of
+                                       # chunk k's packed output, so the
+                                       # host<->device round trip (~100 ms
+                                       # on tunnelled chips) overlaps the
+                                       # next chunk's execution. Costs one
+                                       # chunk of extra latency on host-
+                                       # side stop detection and token
+                                       # streaming; requires a fully
+                                       # backed page pool (num_pages >=
+                                       # max_slots * max_pages_per_seq)
     # ---- overload handling (continuous engine; VERDICT r2 item 2) ----
     max_waiting: int = 0               # waiting-queue cap: submit raises a
                                        # typed EngineOverloadedError once

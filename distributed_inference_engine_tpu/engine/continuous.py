@@ -145,6 +145,19 @@ class ContinuousEngine:
             # random init first
             raise ValueError(
                 f"decode_mode {cfg.decode_mode!r} is not 'window'|'inline'")
+        # defer_sync needs a fully backed pool: host lengths go one chunk
+        # stale, and only a pool that can always grow every slot to
+        # max_seq_len guarantees a chunk never writes past reserved pages.
+        # Checked here (cfg+spec only) for the same pay-nothing-first
+        # reason as decode_mode; re-asserted against the pool's own
+        # max_pages_per_seq after construction so the two formulas cannot
+        # silently diverge.
+        if cfg.defer_sync and cfg.num_pages < cfg.max_slots * (
+                -(-min(cfg.max_seq_len, spec.max_seq_len)
+                  // cfg.page_size)):
+            raise ValueError(
+                "defer_sync needs a fully backed page pool: num_pages >= "
+                "max_slots * ceil(max_seq_len / page_size)")
         if params is None:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
@@ -171,6 +184,14 @@ class ContinuousEngine:
             impl = "xla"
         self.attn_impl = impl
         self.prefix_cache = bool(cfg.prefix_cache)
+        # defer_sync: chunk k's packed output is read AFTER dispatching
+        # chunk k+1, overlapping the host round trip with device compute
+        # (validated pre-init above; the pool's own bound must agree)
+        self._defer = bool(cfg.defer_sync)
+        assert not self._defer or cfg.num_pages >= (
+            cfg.max_slots * self.kv.max_pages_per_seq)
+        # (packed device buffer, n_steps, slot snapshot, dispatch t0)
+        self._pending: Optional[Tuple] = None
         self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
         self._prefix_hit_admissions = 0
         # chunked prefill: chunk must be page-aligned so every suffix chunk
@@ -1106,22 +1127,35 @@ class ContinuousEngine:
     def step(self) -> int:
         """One engine iteration: admit, advance one prefill chunk, then one
         decode chunk. Returns live + mid-prefill slots after the
-        iteration."""
+        iteration. With ``defer_sync``, chunk k's packed output is read
+        after dispatching chunk k+1 (the round trip overlaps device
+        compute); host bookkeeping — finishes, host-side stops, streaming
+        — runs one chunk behind the device."""
         self._try_admit()
         self._advance_chunked()
         if not self._slots:
+            # drop a stale deferred chunk: when processing chunk N frees
+            # the last live slots, the already-dispatched chunk N+1 stays
+            # pending with every snapshot entry no longer current —
+            # processing it would be a no-op, so release its device
+            # buffer and _Slot references here instead of holding them
+            # across an idle period
+            self._pending = None
             return len(self._prefilling)
         self._steps += 1
         self._occupancy_sum += len(self._slots)   # batch occupancy metric
 
-        # capacity: grow every active slot toward a full chunk; a slot that
-        # can't even fit one more token is finished (pool pressure or cap)
+        # capacity: grow every active slot toward a full chunk (two chunks
+        # under defer_sync: the device may already be n_steps past the
+        # host mirror); a slot that can't even fit one more token is
+        # finished (pool pressure or cap)
         n_steps = self.config.decode_steps_per_call
         lengths_np = self._lengths_host
+        ahead = 2 * n_steps if self._defer else n_steps
         retired: List[int] = []
         for slot in list(self._slots):
             cur = int(lengths_np[slot])
-            cap_tok = self.kv.ensure_capacity(slot, cur + n_steps)
+            cap_tok = self.kv.ensure_capacity(slot, cur + ahead)
             if cap_tok <= cur:
                 self._capacity_finishes += 1
                 retired.append(slot)
@@ -1143,8 +1177,13 @@ class ContinuousEngine:
         if self._use_dense_ctx:
             # dense working buffer covers the longest LIVE prefix, padded
             # to a pow2 page bucket (one compiled chunk per bucket) — NOT
-            # max_pages_per_seq, so short-context rounds read short buffers
+            # max_pages_per_seq, so short-context rounds read short
+            # buffers. Under defer_sync the mirror is one chunk stale, so
+            # pad by the in-flight chunk's worst-case growth.
             mx = max(int(self._lengths_host[s]) for s in self._slots)
+            if self._defer:
+                mx = min(mx + self.config.decode_steps_per_call,
+                         self.max_seq_len)
             mpb = _next_bucket(-(-mx // self.kv.page_size),
                                self._ctx_page_buckets)
         sampling = SamplingParams(self._temps, self._top_k, self._top_p,
@@ -1159,15 +1198,43 @@ class ContinuousEngine:
         kp, vp, self._lengths, self._last, self._active, self._produced = carry
         self.kv.swap(kp, vp)
 
+        # snapshot at dispatch: packed columns belong to THESE _Slot
+        # objects — a slot freed and re-admitted before this chunk is
+        # processed must not have the old chunk's column applied to it
+        snapshot = dict(self._slots)
+        if self._defer:
+            prev, self._pending = self._pending, (packed, n_steps,
+                                                  snapshot, t0)
+            if prev is not None:
+                self._process_packed(*prev)
+        else:
+            self._process_packed(packed, n_steps, snapshot, t0)
+        return len(self._slots) + len(self._prefilling)
+
+    def _process_packed(self, packed, n_steps: int,
+                        snapshot: Dict[int, _Slot], t0: float) -> None:
+        """Host bookkeeping of one decode chunk's packed output: append
+        tokens, update the length mirror, detect host-side stops, stream,
+        finish retired slots. ``snapshot`` is the slot map at dispatch —
+        entries whose ``_Slot`` is no longer current are skipped."""
+        t_read = time.perf_counter()
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
         lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)
         active_np = packed_np[-2].astype(bool)
-        self._lengths_host = packed_np[-1].astype(np.int32)
-        self.chunk_stats.add(time.perf_counter() - t0)
+        lengths_row = packed_np[-1].astype(np.int32)
+        # sync: dispatch-to-ready per chunk. defer: dispatch time would
+        # span a whole unrelated host step (samples overlapping wall
+        # clock), so record the actual blocking WAIT — the residue the
+        # overlap failed to hide; near zero means the overlap is working
+        self.chunk_stats.add(time.perf_counter()
+                             - (t_read if self._defer else t0))
 
         stop_retired: List[int] = []
-        for slot, state in list(self._slots.items()):
+        for slot, state in snapshot.items():
+            if self._slots.get(slot) is not state:
+                continue                 # finished earlier (or slot reused)
+            self._lengths_host[slot] = lengths_row[slot]
             col = toks_np[:, slot]
             lcol = lps_np[:, slot]
             prev = len(state.tokens)           # first index not yet stop-checked
@@ -1195,7 +1262,6 @@ class ContinuousEngine:
                 stop_retired.append(slot)
                 self._finish(slot, "stop")
         self._deactivate_many(stop_retired)
-        return len(self._slots) + len(self._prefilling)
 
     def _deactivate_many(self, slots: List[int]) -> None:
         """Clear retired slots' device active flags in ONE dispatch — a
@@ -1253,6 +1319,7 @@ class ContinuousEngine:
         decode step fails irrecoverably."""
         n = (len(self._waiting) + len(self._waiting_prefilled)
              + len(self._slots) + len(self._prefilling))
+        self._pending = None            # drop an unprocessed deferred chunk
         self._waiting.clear()
         self._waiting_prefilled.clear()
         for slot in list(self._slots):

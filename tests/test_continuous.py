@@ -205,3 +205,42 @@ def test_decode_mode_inline_matches_window():
     with pytest.raises(ValueError, match="decode_mode"):
         ContinuousEngine(spec, config=EngineConfig(decode_mode="bogus",
                                                    **base))
+
+
+def test_defer_sync_matches_synchronous_output():
+    """defer_sync overlaps the packed readback with the next chunk's
+    execution; outputs must be token-for-token the synchronous engine's,
+    including mid-flight admissions and host-side stop sequences (which
+    defer detects one chunk late but trims identically)."""
+    rs = np.random.RandomState(7)
+    # fully backed pool (defer requirement): 4 slots x 8 pages
+    cfg = lambda **kw: _cfg(num_pages=32, **kw)
+    sync = ContinuousEngine(SPEC, config=cfg(), seed=0)
+    defer = ContinuousEngine(SPEC, params=sync.params,
+                             config=cfg(defer_sync=True), seed=0)
+    reqs = _reqs(rs, 3, max_new=14)
+    reqs[1].stop_sequences = [[int(x)] for x in
+                              sync.generate([_reqs(rs, 1)[0]])[0].tokens[:1]]
+    sync2 = ContinuousEngine(SPEC, params=sync.params, config=cfg(), seed=0)
+
+    def run(eng):
+        ids = [eng.submit(r) for r in
+               [GenerationRequest(prompt=r.prompt,
+                                  max_new_tokens=r.max_new_tokens,
+                                  stop_sequences=r.stop_sequences,
+                                  request_id=r.request_id) for r in reqs[:2]]]
+        eng.step()                              # mid-flight admission below
+        ids.append(eng.submit(GenerationRequest(
+            prompt=reqs[2].prompt, max_new_tokens=10, request_id="late")))
+        out = {r.request_id: (r.tokens, r.finish_reason)
+               for r in eng.run_until_idle()}
+        return {i: out[i] for i in ids}
+
+    assert run(sync2) == run(defer)
+
+
+def test_defer_sync_requires_fully_backed_pool():
+    import pytest
+
+    with pytest.raises(ValueError, match="fully backed"):
+        ContinuousEngine(SPEC, config=_cfg(defer_sync=True, num_pages=8))
