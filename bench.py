@@ -52,7 +52,10 @@ NORTH_STAR_TOKS = 1000.0      # BASELINE.json: >=1k output tok/s, 8B class
 
 MODEL = os.environ.get("BENCH_MODEL", "llama3-8b")
 IS_BIG = "8b" in MODEL or "7b" in MODEL
-QUANT = os.environ.get("BENCH_QUANT", "1" if IS_BIG else "0") == "1"
+# BENCH_QUANT: 0 = full precision, 1/8 = int8 weight-only, 4 = packed int4
+_Q = os.environ.get("BENCH_QUANT", "1" if IS_BIG else "0")
+QUANT = _Q not in ("0", "")
+QUANT_BITS = 4 if _Q == "4" else 8
 ENGINE_KIND = os.environ.get("BENCH_ENGINE", "continuous")
 # default 64 slots: the throughput-serving configuration (batch sweep in
 # README — aggregate tok/s scales ~5x from bs8 while TTFT stays sub-second)
@@ -103,7 +106,8 @@ def _build_params(spec, quant: bool):
 
     if not quant:
         return None                      # engine does its own random init
-    return random_quantized_params(spec, jax.random.key(0))
+    return random_quantized_params(spec, jax.random.key(0),
+                                   bits=QUANT_BITS)
 
 
 def _engine(spec, params, kind: str, batch: int, steps: int):
@@ -257,7 +261,8 @@ def decode_main() -> None:
     log(f"p50 TTFT: {ttft_ms:.1f} ms; roofline: {roof}")
     suffix = "" if ENGINE_KIND == "continuous" else f"_{ENGINE_KIND}"
     row = {
-        "metric": f"decode_throughput_{MODEL}{'_int8' if QUANT else ''}"
+        "metric": f"decode_throughput_{MODEL}"
+                  f"{f'_int{QUANT_BITS}' if QUANT else ''}"
                   f"_bs{BATCH}{suffix}",
         "value": round(best_toks, 1),
         "unit": "tok/s",
@@ -378,7 +383,8 @@ def serving_main() -> None:
         f"{ttft_p50:.0f} ms p99 {ttft_p99:.0f} ms; ITL p99 {itl_p99:.1f} ms; "
         f"occupancy {occ:.2f}")
     print(json.dumps({
-        "metric": f"serving_throughput_{MODEL}{'_int8' if QUANT else ''}"
+        "metric": f"serving_throughput_{MODEL}"
+                  f"{f'_int{QUANT_BITS}' if QUANT else ''}"
                   f"_rate{rate:g}",
         "value": round(toks_per_s, 1),
         "unit": "tok/s",

@@ -36,6 +36,7 @@ active" per round (SURVEY.md §7 hard-part #1 discipline).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from functools import partial
@@ -98,7 +99,9 @@ def truncated_draft(spec: ModelSpec, params: Params,
     def cut(x):
         if isinstance(x, QuantizedTensor):
             s = x.s[:n_layers] if x.s.shape and x.s.shape[0] == L else x.s
-            return QuantizedTensor(q=x.q[:n_layers], s=s)
+            # bits/pack_axis ride along (pack_axis is end-relative, so the
+            # leading-layer slice leaves it valid)
+            return dataclasses.replace(x, q=x.q[:n_layers], s=s)
         return x[:n_layers]
 
     d_params = dict(params)                 # non-block leaves shared

@@ -166,14 +166,18 @@ def engine_from_config(cfg):
 
         from ..ops.quant import quantize_params, random_quantized_params
 
+        # metadata.weight_bits=4 selects packed-nibble int4 (half the int8
+        # stream again); default 8
+        bits = int(cfg.metadata.get("weight_bits", 8))
         if params is None:
-            # direct int8 init: init-then-quantize would peak at the full
-            # bf16 tree + f32 working copies — OOM at exactly the 8B-on-
-            # one-chip deploys the quantized flag exists for
-            params = random_quantized_params(spec, _jax.random.key(
-                int(cfg.metadata.get("seed", 0))))
+            # direct quantized init: init-then-quantize would peak at the
+            # full bf16 tree + f32 working copies — OOM at exactly the
+            # 8B-on-one-chip deploys the quantized flag exists for
+            params = random_quantized_params(
+                spec, _jax.random.key(int(cfg.metadata.get("seed", 0))),
+                bits=bits)
         else:
-            params = quantize_params(spec, params)
+            params = quantize_params(spec, params, bits=bits)
     ecfg = EngineConfig(max_slots=cfg.max_batch_size,
                         max_seq_len=cfg.max_seq_len)
     for k in ("page_size", "num_pages", "decode_steps_per_call",

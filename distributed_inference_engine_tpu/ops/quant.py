@@ -21,6 +21,16 @@ Scheme: symmetric per-output-channel int8.
 ``QuantizedTensor`` is a pytree, so quantized params flow through
 ``lax.scan`` over stacked layer blocks unchanged: the scan slices ``q`` and
 ``s`` along the layer axis together.
+
+int4 (packed nibbles, ``bits=4``) — MEASURED NEGATIVE on this compiler
+path, kept as a capability: correctness is fully tested (pack round-trip,
+fused-matmul-vs-dequantized parity, engine token parity), and the packed
+tree halves int8's storage/checkpoint bytes, but at the 8B bench rung it
+decodes at 1,584 tok/s vs int8's 3,661 (hbm_util 0.16): XLA materializes
+the unpacked operand instead of fusing the nibble shifts into the dot
+feed, so HBM sees 2-byte traffic plus the packed read. A real int4
+bandwidth win needs a Mosaic/Pallas matmul kernel with in-register
+unpack — future work; int8 is the measured sweet spot today.
 """
 
 from __future__ import annotations
@@ -35,49 +45,141 @@ import jax.numpy as jnp
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedTensor:
-    """int8 weight + broadcastable per-channel scales (dequant = q * s)."""
+    """Quantized weight + broadcastable per-channel scales.
 
-    q: jnp.ndarray   # int8, same shape as the original weight
+    ``bits=8`` (default): ``q`` is int8, same shape as the original weight;
+    dequant = q * s. ``bits=4``: ``q`` is int8 holding TWO int4 values per
+    byte, packed along ``pack_axis`` (the matmul's contraction axis, halved
+    in shape) — even source indices in the low nibble, odd in the high.
+    ``bits``/``pack_axis`` are pytree aux data (static), so quantized trees
+    flow through jit/scan/shard machinery unchanged.
+    """
+
+    q: jnp.ndarray   # int8 payload (bits=4: contraction axis halved)
     s: jnp.ndarray   # float32; shape = weight shape with input axes size 1
+    bits: int = 8
+    pack_axis: int = 0               # bits=4 only: the halved axis, stored
+                                     # NEGATIVE (from the end) so slicing
+                                     # the stacked [L, ...] layer axis off
+                                     # (lax.scan, truncated_draft) leaves
+                                     # it pointing at the same dim
 
     def tree_flatten(self):
-        return (self.q, self.s), None
+        return (self.q, self.s), (self.bits, self.pack_axis)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        bits, pack_axis = aux if isinstance(aux, tuple) else (8, -1)
+        return cls(*children, bits=bits, pack_axis=pack_axis)
 
     @property
     def shape(self):
+        if self.bits == 4:
+            a = self.pack_axis % self.q.ndim
+            return tuple(d * 2 if i == a else d
+                         for i, d in enumerate(self.q.shape))
         return self.q.shape
 
     @property
     def nbytes(self) -> int:
         return self.q.size * 1 + self.s.size * self.s.dtype.itemsize
 
+    def _unpacked_int8(self) -> jnp.ndarray:
+        """bits=4: int8 values at the ORIGINAL shape (materializing — for
+        dequantize/tests; the matmul path unpacks into the dot operand
+        without a stacked intermediate)."""
+        assert self.bits == 4
+        a = self.pack_axis % self.q.ndim
+        lo = jnp.right_shift(jnp.left_shift(self.q, 4), 4)
+        hi = jnp.right_shift(self.q, 4)
+        return jnp.stack([lo, hi], axis=a + 1).reshape(self.shape)
+
     def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
-        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+        q = self._unpacked_int8() if self.bits == 4 else self.q
+        return (q.astype(jnp.float32) * self.s).astype(dtype)
 
 
-def quantize_weight(w: jnp.ndarray,
-                    reduce_axes: Sequence[int]) -> QuantizedTensor:
-    """Symmetric int8 over ``reduce_axes`` (the matmul's contraction axes;
-    remaining axes are output/batch channels, one scale each)."""
+def quantize_weight(w: jnp.ndarray, reduce_axes: Sequence[int],
+                    bits: int = 8) -> QuantizedTensor:
+    """Symmetric int8/int4 over ``reduce_axes`` (the matmul's contraction
+    axes; remaining axes are output/batch channels, one scale each).
+
+    ``bits=4`` halves the HBM weight stream again: values in [-7, 7]
+    (symmetric — -8 is unused), two per byte, packed along the FIRST
+    reduce axis (must be even-sized)."""
     w32 = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=tuple(reduce_axes), keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return QuantizedTensor(q=q, s=scale)
+    if bits == 8:
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+        return QuantizedTensor(q=q, s=scale)
+    if bits != 4:
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    a = sorted(int(ax) % w32.ndim for ax in reduce_axes)[0]
+    if w32.shape[a] % 2:
+        raise ValueError(f"int4 pack axis {a} has odd size {w32.shape[a]}")
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(w32 / scale), -7, 7).astype(jnp.int8)
+    even = jax.lax.slice_in_dim(q, 0, q.shape[a], stride=2, axis=a)
+    odd = jax.lax.slice_in_dim(q, 1, q.shape[a], stride=2, axis=a)
+    packed = jax.lax.bitcast_convert_type(
+        (even.astype(jnp.uint8) & 0xF) | (odd.astype(jnp.uint8) << 4),
+        jnp.int8)
+    return QuantizedTensor(q=packed, s=scale, bits=4,
+                           pack_axis=a - w32.ndim)
+
+
+def _einsum_int4(pattern: str, x: jnp.ndarray,
+                 w: QuantizedTensor) -> jnp.ndarray:
+    """Packed-int4 einsum: the contraction axis splits into (pairs, 2) on
+    BOTH operands, and the weight side is the packed byte broadcast over
+    the nibble axis with per-nibble shifts — pure elementwise/broadcast
+    producers that XLA fuses into the dot operand, so only the packed
+    bytes cross HBM (no stacked/interleaved intermediate)."""
+    lhs, out = pattern.split("->")
+    xs, ws = lhs.split(",")
+    contract = [ch for ch in ws if ch.isalpha() and ch in xs
+                and ch not in out]
+    if len(contract) != 1:
+        raise ValueError(
+            f"int4 matmul needs exactly one contraction axis in {pattern!r}")
+    c = contract[0]
+    assert "P" not in pattern and "Q" not in pattern
+    new = f"{xs.replace(c, c + 'P')},{ws.replace(c, c + 'P')}->{out}"
+    ax_w = ws.index(c)
+    if ax_w != w.pack_axis % w.q.ndim:
+        raise ValueError(
+            f"pattern {pattern!r} contracts axis {ax_w} but the int4 "
+            f"payload is packed along axis {w.pack_axis % w.q.ndim}")
+    # x: split the contraction axis into (half, 2) — even index -> low
+    # nibble, odd -> high, matching quantize_weight's packing
+    tail = xs.replace("...", "")
+    ax_x = x.ndim - len(tail) + tail.index(c)
+    xr = x.reshape(x.shape[:ax_x] + (x.shape[ax_x] // 2, 2)
+                   + x.shape[ax_x + 1:])
+    # w: broadcast the packed byte over a nibble axis; shift [4, 0] then
+    # arithmetic >> 4 sign-extends each nibble
+    qb = jnp.expand_dims(w.q, ax_w + 1)
+    shift_shape = [1] * qb.ndim
+    shift_shape[ax_w + 1] = 2
+    shifts = jnp.asarray([4, 0], jnp.int8).reshape(shift_shape)
+    wu = jnp.right_shift(jnp.left_shift(qb, shifts), 4).astype(x.dtype)
+    y = jnp.einsum(new, xr, wu)
+    return y * _out_scale(w.s).astype(y.dtype)
 
 
 def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """``einsum`` that accepts a plain array or a ``QuantizedTensor``.
 
-    For a quantized weight the int8 payload is cast to the activation dtype
+    For a quantized weight the payload is widened to the activation dtype
     at the MXU feed and the per-output-channel scale multiplies the result
     — valid because the scale is constant over every contracted axis.
+    int8 streams the bytes directly; packed int4 unpacks INSIDE the dot
+    operand (``_einsum_int4``), so HBM sees half the int8 bytes.
     """
     if isinstance(w, QuantizedTensor):
+        if w.bits == 4:
+            return _einsum_int4(pattern, x, w)
         y = jnp.einsum(pattern, x, w.q.astype(x.dtype))
         return y * _out_scale(w.s).astype(y.dtype)
     return jnp.einsum(pattern, x, w)
@@ -114,8 +216,10 @@ _MOE_WEIGHTS: Dict[str, Tuple[int, ...]] = {
 }
 
 
-def quantize_params(spec, params: Dict[str, Any]) -> Dict[str, Any]:
-    """Quantize the big matmul weights of a loaded/initialised param tree.
+def quantize_params(spec, params: Dict[str, Any],
+                    bits: int = 8) -> Dict[str, Any]:
+    """Quantize the big matmul weights of a loaded/initialised param tree
+    (``bits``: 8 or 4 — packed nibbles, see ``quantize_weight``).
 
     Kept full-precision: embeddings (gather, not matmul), norms, biases,
     the MoE router (tiny and precision-sensitive), and a tied LM head
@@ -130,15 +234,16 @@ def quantize_params(spec, params: Dict[str, Any]) -> Dict[str, Any]:
             continue
         if moe and name in _MOE_WEIGHTS:
             axes = _MOE_WEIGHTS[name]
-        blocks[name] = quantize_weight(w, [a + 1 for a in axes])
+        blocks[name] = quantize_weight(w, [a + 1 for a in axes], bits=bits)
     out["blocks"] = blocks
     if (not spec.tie_embeddings and "lm_head" in out
             and not isinstance(out["lm_head"], QuantizedTensor)):
-        out["lm_head"] = quantize_weight(out["lm_head"], (0,))
+        out["lm_head"] = quantize_weight(out["lm_head"], (0,), bits=bits)
     return out
 
 
-def random_quantized_params(spec, key, w_std: float = 0.02) -> Dict[str, Any]:
+def random_quantized_params(spec, key, w_std: float = 0.02,
+                            bits: int = 8) -> Dict[str, Any]:
     """int8 param tree initialized DIRECTLY — no full-precision source.
 
     Random-init quantized serving at 8B scale cannot init-then-quantize:
@@ -164,9 +269,29 @@ def random_quantized_params(spec, key, w_std: float = 0.02) -> Dict[str, Any]:
     nk = lambda: jax.random.fold_in(key, next(counter))
 
     def q_leaf(leaf, axes):
-        q = jax.random.randint(nk(), leaf.shape, -127, 128, dtype=jnp.int8)
         s_shape = tuple(1 if i in axes else d
                         for i, d in enumerate(leaf.shape))
+        if bits == 4:
+            # two uniform nibbles in [-7, 7] per byte, born packed; a
+            # uniform-int[-n, n] payload has std sqrt(n(n+1)/3), so the
+            # constant scale w_std/that keeps the effective weight std at
+            # ~w_std (same correction as the int8 path)
+            a = axes[0]
+            if leaf.shape[a] % 2:
+                raise ValueError(
+                    f"int4 pack axis {a} has odd size {leaf.shape[a]}")
+            half = tuple(d // 2 if i == a else d
+                         for i, d in enumerate(leaf.shape))
+            even = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
+            odd = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
+            packed = jax.lax.bitcast_convert_type(
+                (even.astype(jnp.uint8) & 0xF)
+                | (odd.astype(jnp.uint8) << 4), jnp.int8)
+            std4 = (7 * 8 / 3.0) ** 0.5
+            return QuantizedTensor(
+                q=packed, s=jnp.full(s_shape, w_std / std4, jnp.float32),
+                bits=4, pack_axis=a - len(leaf.shape))
+        q = jax.random.randint(nk(), leaf.shape, -127, 128, dtype=jnp.int8)
         return QuantizedTensor(
             q=q, s=jnp.full(s_shape, w_std * (3.0 ** 0.5) / 127.0,
                             jnp.float32))

@@ -222,7 +222,13 @@ def shard_params(params: Params, shardings: ModelShardings) -> Params:
 
     def place(x, s: NamedSharding):
         if isinstance(x, QuantizedTensor):
-            return QuantizedTensor(
+            import dataclasses
+
+            # replace, not reconstruct: bits/pack_axis aux must survive
+            # placement (an int4 tree rebuilt as default-int8 would feed
+            # a contraction-halved payload to the int8 matmul path)
+            return dataclasses.replace(
+                x,
                 q=place_arr(x.q, s),
                 s=jax.device_put(x.s, scale_sharding(x.s.shape, s)),
             )

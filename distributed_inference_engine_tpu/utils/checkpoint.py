@@ -38,7 +38,12 @@ def _encode_tree(tree: Any) -> Any:
         if isinstance(node, QuantizedTensor):
             import numpy as np
 
-            return {_QUANT_MARKER: np.int8(1), "q": node.q, "s": node.s}
+            # bits/pack_axis persist as tiny arrays (orbax stores arrays):
+            # an int4 checkpoint restored as default-int8 would be
+            # silently mis-shaped
+            return {_QUANT_MARKER: np.int8(1), "q": node.q, "s": node.s,
+                    "bits": np.int32(node.bits),
+                    "pack_axis": np.int32(node.pack_axis)}
         if isinstance(node, dict):
             return {k: enc(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
@@ -54,7 +59,11 @@ def _decode_tree(tree: Any) -> Any:
     def dec(node: Any) -> Any:
         if isinstance(node, dict):
             if _QUANT_MARKER in node:
-                return QuantizedTensor(q=node["q"], s=node["s"])
+                # pre-int4 checkpoints carry no bits field -> int8
+                return QuantizedTensor(
+                    q=node["q"], s=node["s"],
+                    bits=int(node.get("bits", 8)),
+                    pack_axis=int(node.get("pack_axis", 0)))
             return {k: dec(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
             return type(node)(dec(v) for v in node)

@@ -306,3 +306,26 @@ async def test_coordinator_cache_persists_across_restart(tmp_path):
         await coord2.stop()
     finally:
         await w.stop()
+
+
+def test_int4_tree_roundtrips_through_checkpoint(tmp_path):
+    """bits/pack_axis persist: an int4 checkpoint must restore as int4,
+    not silently as a mis-shaped int8 tree (r3 review finding)."""
+    import jax
+
+    from distributed_inference_engine_tpu.models.base import init_params
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+    from distributed_inference_engine_tpu.ops.quant import quantize_params
+    from distributed_inference_engine_tpu.utils.checkpoint import (
+        load_params,
+        save_params,
+    )
+
+    spec = llama_spec("llama-tiny", max_seq_len=64).replace(dtype="float32")
+    q4 = quantize_params(spec, init_params(spec, jax.random.key(0)), bits=4)
+    path = str(tmp_path / "ckpt4")
+    save_params(path, spec, q4)
+    back = load_params(path)
+    wq = back["blocks"]["wq"]
+    assert wq.bits == 4 and wq.pack_axis == q4["blocks"]["wq"].pack_axis
+    assert wq.q.shape == q4["blocks"]["wq"].q.shape

@@ -143,3 +143,114 @@ def test_quantized_speculative_composes():
     out = eng.generate([GenerationRequest(prompt=[1, 2, 3],
                                           max_new_tokens=6)])[0]
     assert len(out.tokens) == 6
+
+
+# ------------------------------------------------------------------- int4
+
+
+def test_int4_pack_roundtrip_exact():
+    """Packed nibbles decode back to the exact int4 values."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.ops.quant import quantize_weight
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(8, 6).astype("float32")
+    qt = quantize_weight(jnp.asarray(w), (0,), bits=4)
+    assert qt.bits == 4 and qt.q.shape == (4, 6) and qt.shape == (8, 6)
+    vals = np.asarray(qt._unpacked_int8())
+    assert vals.min() >= -7 and vals.max() <= 7
+    # round-trip against direct per-channel quantization
+    scale = np.maximum(np.abs(w).max(axis=0, keepdims=True), 1e-8) / 7.0
+    ref = np.clip(np.round(w / scale), -7, 7)
+    np.testing.assert_array_equal(vals, ref)
+
+
+def test_int4_matmul_matches_dequantized_reference():
+    """_einsum_int4 (fused unpack in the dot operand) == explicit
+    dequantize-then-einsum, for every pattern the model uses."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.ops.quant import (
+        matmul_any,
+        quantize_weight,
+    )
+
+    rs = np.random.RandomState(1)
+    x2 = jnp.asarray(rs.randn(3, 5, 8).astype("float32"))
+    for pattern, wshape, axes in (
+        ("btd,df->btf", (8, 12), (0,)),
+        ("...d,dv->...v", (8, 10), (0,)),
+    ):
+        w = jnp.asarray(rs.randn(*wshape).astype("float32"))
+        qt = quantize_weight(w, axes, bits=4)
+        got = matmul_any(pattern, x2, qt)
+        ref = jnp.einsum(pattern, x2, qt.dequantize(jnp.float32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_int4_survives_layer_scan_slicing():
+    """pack_axis is end-relative: slicing the stacked layer axis (what
+    lax.scan and truncated_draft do) leaves the packing valid."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.ops.quant import (
+        matmul_any,
+        quantize_weight,
+    )
+
+    rs = np.random.RandomState(2)
+    w = jnp.asarray(rs.randn(3, 8, 12).astype("float32"))   # [L, D, F]
+    qt = quantize_weight(w, (1,), bits=4)
+
+    def per_layer(x, q_l):
+        return matmul_any("bd,df->bf", x, q_l)
+
+    x = jnp.asarray(rs.randn(2, 8).astype("float32"))
+    out = jax.lax.scan(lambda c, q_l: (c, per_layer(x, q_l)), None, qt)[1]
+    ref = jnp.stack([jnp.einsum("bd,df->bf", x,
+                                quantize_weight(w[i], (0,), bits=4)
+                                .dequantize(jnp.float32))
+                     for i in range(3)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int4_engine_generates_and_matches_dequantized_engine():
+    """A continuous engine on int4 params produces the same greedy tokens
+    as the same engine on the explicitly dequantized tree."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+    from distributed_inference_engine_tpu.engine.types import (
+        GenerationRequest,
+    )
+    from distributed_inference_engine_tpu.models.base import init_params
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+    from distributed_inference_engine_tpu.ops.quant import (
+        QuantizedTensor,
+        quantize_params,
+    )
+
+    spec = llama_spec("llama-tiny", max_seq_len=128).replace(dtype="float32")
+    params = init_params(spec, jax.random.key(0))
+    q4 = quantize_params(spec, params, bits=4)
+    assert q4["blocks"]["wq"].bits == 4
+    deq = jax.tree.map(
+        lambda x: x.dequantize(jnp.float32)
+        if isinstance(x, QuantizedTensor) else x,
+        q4, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    cfg = EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=[32],
+                      page_size=16, num_pages=32, decode_steps_per_call=4,
+                      kv_dtype="float32")
+    reqs = lambda: [GenerationRequest(prompt=list(range(1, 20)),
+                                      max_new_tokens=8, request_id="q")]
+    e4 = ContinuousEngine(spec, params=q4, config=cfg)
+    ed = ContinuousEngine(spec, params=deq, config=cfg)
+    t4 = e4.generate(reqs())[0].tokens
+    td = ed.generate(reqs())[0].tokens
+    assert t4 == td and len(t4) == 8
