@@ -165,6 +165,12 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
         cfg.decode_mode = os.environ["BENCH_DECODE_MODE"]
     elif not IS_BIG:
         cfg.decode_mode = "inline"
+    if os.environ.get("BENCH_PREFILL_CHUNK"):
+        # chunked prefill: long prompts prefill in page-aligned chunks
+        # interleaved with decode (bounds the admission stall on live
+        # decodes); needs prompt-length buckets below the chunk too
+        cfg.prefill_chunk = int(os.environ["BENCH_PREFILL_CHUNK"])
+        cfg.prefill_buckets = sorted({cfg.prefill_chunk, PROMPT_LEN})
     return ContinuousEngine(spec, params=params, config=cfg)
 
 
