@@ -168,9 +168,12 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
     if os.environ.get("BENCH_PREFILL_CHUNK"):
         # chunked prefill: long prompts prefill in page-aligned chunks
         # interleaved with decode (bounds the admission stall on live
-        # decodes); needs prompt-length buckets below the chunk too
-        cfg.prefill_chunk = int(os.environ["BENCH_PREFILL_CHUNK"])
-        cfg.prefill_buckets = sorted({cfg.prefill_chunk, PROMPT_LEN})
+        # decodes). Mirror the engine's page rounding when building the
+        # bucket set, or every chunk pads to the raw (unrounded) bucket
+        raw = int(os.environ["BENCH_PREFILL_CHUNK"])
+        cfg.prefill_chunk = raw
+        chunk = max(cfg.page_size, raw // cfg.page_size * cfg.page_size)
+        cfg.prefill_buckets = sorted({chunk, PROMPT_LEN})
     return ContinuousEngine(spec, params=params, config=cfg)
 
 
