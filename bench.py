@@ -158,9 +158,10 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
     cfg.page_size = 128
     per_seq = -(-(PROMPT_LEN + NEW_TOKENS) // cfg.page_size)  # ceil
     cfg.num_pages = max(64, batch * per_seq + 8)
-    # measured crossover (README table): windowed chunks win when weight
-    # streaming dominates (8B: 2658 vs 1038 tok/s); small-KV models keep
-    # the inline per-step scatter (GPT-2: 10673 vs 7169)
+    # measured crossover (README table): dense-ctx window chunks win when
+    # weight streaming dominates (8B: 3661 r3 vs 1038 for per-step pool
+    # scatter); small-KV models keep the inline scatter (GPT-2: 10673 vs
+    # 7169)
     if os.environ.get("BENCH_DECODE_MODE"):
         cfg.decode_mode = os.environ["BENCH_DECODE_MODE"]
     elif not IS_BIG:

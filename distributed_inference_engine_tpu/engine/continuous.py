@@ -244,7 +244,10 @@ class ContinuousEngine:
                 "prefill_chunk and sp compose poorly: both bound the "
                 "decode stall from long-prompt admission (chunking in "
                 "time, sp in space), and the suffix-chunk programs are "
-                "not sequence-parallel — pick one")
+                "not sequence-parallel — pick one. Measured guidance "
+                "(README, r3): chunking LOSES below multi-second "
+                "admission stalls, so sp is the right pick for long-"
+                "prompt deploys that have a mesh")
         if has_sp:
             from .engine import _check_same_mesh
 
