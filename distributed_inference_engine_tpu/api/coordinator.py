@@ -482,12 +482,21 @@ class Coordinator:
                                              counting_cb)
         except WorkerRPCError as e:
             # streaming shed: same contract as the batch path (one
-            # alternate, then the typed error + counter) — nothing has
-            # streamed yet when the shed happens at admission
-            if getattr(e, "kind", "") != "overloaded" or delivered:
+            # alternate, then the typed error + counter). Today sheds
+            # happen at admission, before anything streams; if one ever
+            # arrives after tokens were delivered we can't retry (a
+            # restart would replay tokens) but the caller still gets the
+            # typed backoff signal, counted.
+            if getattr(e, "kind", "") != "overloaded":
                 raise
             from ..engine.types import EngineOverloadedError
 
+            if delivered:
+                self._overload_rejections += 1
+                raise EngineOverloadedError(
+                    f"request {request_id} shed after {delivered} tokens "
+                    "streamed; the stream cannot be resumed — back off "
+                    "and retry", reason=_shed_reason(e)) from e
             alt = self._pick_alternate(model, version, worker_id,
                                        affinity, sharded)
             if alt is not None:

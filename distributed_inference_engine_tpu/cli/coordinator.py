@@ -37,8 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="distributed_inference_engine_tpu.cli.coordinator",
         description="serving coordinator (cache -> batcher -> router/LB -> workers)",
     )
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--host", default=None,
+                   help="bind host (default 127.0.0.1; overrides --config)")
+    p.add_argument("--port", type=int, default=None,
+                   help="bind port (default 0 = OS-assigned; overrides "
+                        "--config)")
     p.add_argument("--worker", action="append", default=[],
                    metavar="ID=HOST:PORT", help="worker to register (repeatable)")
     p.add_argument("--deploy", action="append", default=[],
@@ -61,13 +64,18 @@ async def amain(args: argparse.Namespace) -> None:
         tree = load_config(args.config)
         ccfg = CoordinatorConfig.from_config(tree)
         ccfg.lb_strategy = args.lb_strategy   # flag applies in config mode too
-        server_cfg = ServerConfig(worker_id="coordinator",
-                                  host=tree.server.host, port=tree.server.port)
-        deploys = tree.models
+        # explicit --host/--port beat the file (lets one committed config
+        # serve both the pinned-port demo and port-0 test harnesses)
+        server_cfg = ServerConfig(
+            worker_id="coordinator",
+            host=args.host if args.host is not None else tree.server.host,
+            port=args.port if args.port is not None else tree.server.port)
+        deploys = tree.models + [parse_model_arg(m) for m in args.deploy]
     else:
         ccfg = CoordinatorConfig(lb_strategy=args.lb_strategy)
-        server_cfg = ServerConfig(worker_id="coordinator", host=args.host,
-                                  port=args.port)
+        server_cfg = ServerConfig(worker_id="coordinator",
+                                  host=args.host or "127.0.0.1",
+                                  port=args.port or 0)
         deploys = [parse_model_arg(m) for m in args.deploy]
 
     coord = Coordinator(ccfg)

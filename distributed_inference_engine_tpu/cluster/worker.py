@@ -506,15 +506,33 @@ class WorkerServer(FramedServerMixin):
 
         name, engine = self._engine_for(msg, "submit_prefilled")
         kv = getattr(engine, "kv", None)
+        enabled = kv is not None and getattr(engine, "prefix_cache", False)
+        # advertise this pool's page size so the sender can hash with it
+        # on later probes even when its own config disagrees
+        my_page = kv.page_size if enabled else 0
         out: List[int] = []
-        for prompt in msg.get("prompts", []):
-            if kv is None or not getattr(engine, "prefix_cache", False):
+        if "hashes" in msg:
+            # preferred form: 16-byte-per-page chain hashes (the
+            # page_chain_hashes contract) — the sender never ships the
+            # prompt twice. Hashes chain over page-sized token chunks, so
+            # a page-size mismatch means no entry can match: answer 0s
+            # (the sender re-hashes with the advertised size next probe).
+            if not enabled or msg.get("page_size") != kv.page_size:
+                out = [0] * len(msg["hashes"])
+            else:
+                out = [kv.probe_prefix([bytes(h) for h in hs])
+                       * kv.page_size
+                       for hs in msg["hashes"]]
+            return {"model": name, "cached_tokens": out,
+                    "page_size": my_page}
+        for prompt in msg.get("prompts", []):    # legacy full-prompt probe
+            if not enabled:
                 out.append(0)
                 continue
             matchable = (len(prompt) - 1) // kv.page_size
             hashes = page_chain_hashes(prompt, matchable, kv.page_size)
             out.append(kv.probe_prefix(hashes) * kv.page_size)
-        return {"model": name, "cached_tokens": out}
+        return {"model": name, "cached_tokens": out, "page_size": my_page}
 
     async def _rpc_generate_prefilled(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Decode-pool op: admit handed-off KV, decode to completion."""
@@ -614,22 +632,44 @@ class WorkerServer(FramedServerMixin):
             )
             # prefix-aware delta handoff: probe which page-aligned prompt
             # heads the decode pool's prefix cache already holds and ship
-            # only the KV tails. The probe is advisory — a reclaimed page
-            # surfaces as a typed per-request stale_prefix result below,
-            # answered by re-shipping that request's full KV.
+            # only the KV tails. The probe ships 16-byte-per-page chain
+            # hashes (page_chain_hashes — the prompt itself is shipped
+            # exactly once, inside generate_prefilled). Advisory — a
+            # reclaimed page surfaces as a typed per-request stale_prefix
+            # result below, answered by re-shipping that request's full KV.
             from ..engine.disagg import trim_handoff
+            from ..engine.paged_kv import page_chain_hashes
 
             full_handoffs = handoffs             # kept for stale re-sends
-            try:
-                probe = await peer.call(
-                    "prefix_probe", model=decode_model,
-                    prompts=[list(reqs[i].prompt[-h.prompt_len:])
-                             for i, h in zip(g_idxs, handoffs)],
-                    timeout=peer_timeout,
-                )
-                cached = probe.get("cached_tokens", [])
-            except RPCError:
-                cached = []                      # peer predates the probe op
+            # hash with the DECODE pool's page size: its prefix index is
+            # what the chain hashes must match. Learned from the peer's
+            # probe responses (cached on the peer client); until the first
+            # response, fall back to this pool's configured page_size —
+            # the pools share EngineConfig on a standard disagg deploy.
+            # PrefillEngine has no kv, so the config is the only local
+            # source (r4 review finding).
+            page_size = (getattr(peer, "probe_page_size", 0)
+                         or getattr(getattr(engine, "kv", None),
+                                    "page_size", 0)
+                         or getattr(engine.config, "page_size", 0))
+            cached: List[int] = []
+            if page_size > 0:
+                try:
+                    probe = await peer.call(
+                        "prefix_probe", model=decode_model,
+                        page_size=page_size,
+                        hashes=[page_chain_hashes(
+                                    reqs[i].prompt[-h.prompt_len:],
+                                    (h.prompt_len - 1) // page_size,
+                                    page_size)
+                                for i, h in zip(g_idxs, handoffs)],
+                        timeout=peer_timeout,
+                    )
+                    cached = probe.get("cached_tokens", [])
+                    if int(probe.get("page_size", 0)) > 0:
+                        peer.probe_page_size = int(probe["page_size"])
+                except RPCError:
+                    cached = []                  # peer predates the probe op
             cached = cached + [0] * (len(handoffs) - len(cached))
             # probe counts are page-aligned and capped below prompt_len by
             # construction ((len-1)//P pages) — the guard is belt/braces
@@ -645,11 +685,19 @@ class WorkerServer(FramedServerMixin):
             self._handoff_bytes_shipped += sum(
                 len(w["k"]) + len(w["v"]) for w in wires)
             # the up-front prompt-length estimate already bounds every
-            # wire (trimming only shrinks them) — a violation here would
-            # be an accounting bug, and raising mid-pipeline would orphan
-            # shipped groups, so assert rather than raise
-            assert all(s <= budget for s in sizes), \
-                "handoff wire exceeded the up-front size bound"
+            # wire (trimming only shrinks them) — a violation would be an
+            # accounting bug, but it must stay a REAL check (not an
+            # assert, which -O strips): an oversized frame would otherwise
+            # surface as a raw framing error mid-pipeline, orphaning
+            # already-shipped groups. Nothing from THIS group has shipped
+            # yet, so raising here is safe.
+            if any(s > budget for s in sizes):
+                raise ValueError(
+                    "handoff wire exceeded the up-front size bound "
+                    f"({max(sizes)} > {budget} bytes) — the per-token "
+                    "estimate in generate_remote_decode has drifted from "
+                    "handoff_to_wire; fix the estimate"
+                )
             frames: List[List[int]] = []
             cur: List[int] = []
             cur_bytes = 0

@@ -292,9 +292,12 @@ def random_quantized_params(spec, key, w_std: float = 0.02,
                 q=packed, s=jnp.full(s_shape, w_std / std4, jnp.float32),
                 bits=4, pack_axis=a - len(leaf.shape))
         q = jax.random.randint(nk(), leaf.shape, -127, 128, dtype=jnp.int8)
+        # discrete-uniform std over [-127, 127]: sqrt(n(n+1)/3), matching
+        # the int4 path above (the continuous sqrt(3)/127 approximation is
+        # ~0.4% off)
+        std8 = (127 * 128 / 3.0) ** 0.5
         return QuantizedTensor(
-            q=q, s=jnp.full(s_shape, w_std * (3.0 ** 0.5) / 127.0,
-                            jnp.float32))
+            q=q, s=jnp.full(s_shape, w_std / std8, jnp.float32))
 
     def f_leaf(name, leaf):
         if "scale" in name:

@@ -111,6 +111,7 @@ def main():
     log(f"warmup (all buckets): {time.perf_counter() - t0:.1f}s")
 
     pump = EnginePump(engine, idle_wait_s=0.01)
+    bench.prime_pump(pump, spec, bench.BATCH)
     rows = []
     for i, rate in enumerate(rates):
         row = asyncio.run(run_rate(pump, spec, rate, n_requests, 100 + i))

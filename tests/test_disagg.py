@@ -570,16 +570,26 @@ async def test_relay_ships_delta_on_repeat_and_recovers_from_stale():
         long_req = [GenerationRequest(prompt=list(range(1, 40)),
                                       max_new_tokens=4, temperature=0.0,
                                       request_id="lp")]
+        b0 = wp.get_metrics()["handoff_bytes_shipped"]
         await cp.prefill_generate("m", long_req, decode_host=dh,
                                   decode_port=dp)
+        b1 = wp.get_metrics()["handoff_bytes_shipped"]
         r2 = await cp.prefill_generate(
             "m", [GenerationRequest(prompt=list(range(1, 40)),
                                     max_new_tokens=4, temperature=0.0,
                                     request_id="lp2")],
             decode_host=dh, decode_port=dp)
+        b2 = wp.get_metrics()["handoff_bytes_shipped"]
         assert len(r2) == 1 and len(r2[0].tokens) == 4
         m = wd.engines["m"].get_metrics()
         assert m["kv"]["prefix_hit_tokens"] >= 32
+        # the repeat must ship a DELTA on the wire, not just hit the
+        # decode-side prefix counters at admission: 39-token prompt with
+        # 2 full cached pages of 16 → tail of 7 tokens ≈ 7/39 the bytes
+        # (catches the probe silently disabling itself — r4 review)
+        assert 0 < b2 - b1 < (b1 - b0) / 2, (
+            f"repeat shipped {b2 - b1} bytes vs first {b1 - b0} — "
+            "delta handoff did not engage")
         await cp.close()
     finally:
         await wp.stop()
