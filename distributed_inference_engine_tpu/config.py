@@ -112,6 +112,17 @@ class EngineConfig:
                                        # this prefill in chunks interleaved with
                                        # decode (0 = whole-prompt prefill);
                                        # rounded to a multiple of page_size
+    defer_admission: bool = True       # continuous engine: under decode
+                                       # pressure (>=1/4 slots live), skip
+                                       # the blocking first-token read at
+                                       # admission — install firsts device-
+                                       # side and harvest them from the
+                                       # next chunk's packed output (saves
+                                       # one ~100 ms host round trip per
+                                       # admission round on tunnelled
+                                       # chips; first token arrives with
+                                       # the chunk). Light load keeps the
+                                       # sync path for minimal TTFT.
     defer_sync: bool = False           # continuous engine: dispatch chunk
                                        # k+1 BEFORE the blocking read of
                                        # chunk k's packed output, so the

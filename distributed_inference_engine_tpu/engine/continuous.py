@@ -74,7 +74,7 @@ class _Slot:
 
     __slots__ = ("request", "slot_id", "prompt_len", "produced", "tokens",
                  "logprobs", "admitted_at", "first_token_at", "on_tokens",
-                 "streamed", "stop_cut")
+                 "streamed", "stop_cut", "first_pending")
 
     def __init__(self, request: GenerationRequest, slot_id: int,
                  prompt_len: int, on_tokens=None) -> None:
@@ -89,6 +89,10 @@ class _Slot:
         self.on_tokens = on_tokens      # streaming: cb(new_tokens: List[int])
         self.streamed = 0               # tokens already emitted to the cb
         self.stop_cut = -1              # earliest stop cut, once found
+        self.first_pending = False      # deferred admission: the prefill-
+                                        # sampled first token lives in the
+                                        # device firsts buffer until the
+                                        # next chunk's packed read
 
 
 class _PrefillProgress:
@@ -201,6 +205,7 @@ class ContinuousEngine:
                        if cfg.prefill_chunk else 0)
         self._prefilling: Dict[int, _PrefillProgress] = {}   # slot -> progress
         self._chunked_admissions = 0
+        self._deferred_admissions = 0
 
         # ---- queues / state: (request, stream cb or None, t_submit)
         self._waiting: Deque[Tuple[GenerationRequest, Any, float]] = (
@@ -228,6 +233,14 @@ class ContinuousEngine:
         self._top_k = jnp.zeros((n,), jnp.int32)
         self._top_p = jnp.ones((n,), jnp.float32)
         self._min_p = jnp.zeros((n,), jnp.float32)
+        # deferred admission (r4): per-slot [token; logprob-bits] of the
+        # prefill-sampled first token, harvested from the NEXT chunk's
+        # packed output instead of a dedicated blocking read (~a full
+        # round trip per admission round on tunnelled devices, paid while
+        # the device sat idle). Deferral engages only under decode
+        # pressure — see _admit_batch.
+        self._firsts_dev = jnp.zeros((2, n), jnp.int32)
+        self._defer_admit = bool(getattr(cfg, "defer_admission", True))
         # host mirror of per-slot lengths: the capacity loop consults it
         # every step, and a device readback costs a full round trip
         # (~100 ms on tunnelled/remote devices). Updated on admission and
@@ -343,8 +356,8 @@ class ContinuousEngine:
                  donate_argnums=(1, 2, 3, 4, 5, 6))
         def _decode_chunk(
             params, kp, vp, lengths, last_tokens, active, produced,
-            page_table, cap, max_new, sampling, eos_ids, key, n_steps: int,
-            n_ctx_pages: int = 0,
+            page_table, cap, max_new, sampling, eos_ids, firsts, key,
+            n_steps: int, n_ctx_pages: int = 0,
         ):
             start_lengths = lengths
             L = spec_.n_layers
@@ -457,12 +470,13 @@ class ContinuousEngine:
                         kp, vp, side_k, side_v, page_table,
                         lengths - start_lengths, start=start_lengths,
                     )
-            # pack tokens + logprobs (bitcast) + active flags + lengths into
-            # ONE output buffer: the host makes exactly one blocking read
-            # per chunk (each sync is a full round trip on remote devices)
+            # pack tokens + logprobs (bitcast) + active flags + lengths +
+            # the deferred-admission firsts buffer into ONE output buffer:
+            # the host makes exactly one blocking read per chunk (each
+            # sync is a full round trip on remote devices)
             packed = jnp.concatenate(
                 [toks, jax.lax.bitcast_convert_type(lps, jnp.int32),
-                 active[None].astype(jnp.int32), lengths[None]],
+                 active[None].astype(jnp.int32), lengths[None], firsts],
                 axis=0)
             return (kp, vp, lengths, last, active, produced), packed
 
@@ -489,12 +503,45 @@ class ContinuousEngine:
                 min_p.at[i].set(vals["min_p"], **kw),
             )
 
+        @partial(jax.jit, donate_argnums=tuple(range(11)))
+        def _install_first(lengths, last, active, produced, max_new, eos,
+                           temps, top_k, top_p, min_p, firsts_buf, slots,
+                           vals, first_dev, cols):
+            """Deferred-admission install: like ``_install`` but the first
+            tokens stay ON DEVICE — ``first_dev`` is the prefill program's
+            [2, bb] output, ``cols`` maps each row to its column in it.
+            The tokens seed the decode state directly and are parked in
+            ``firsts_buf`` for the host to harvest from the next chunk's
+            packed read (no dedicated blocking readback)."""
+            i = slots
+            kw = dict(mode="drop")
+            sel = first_dev[:, cols]               # [2, bb_rows]
+            # a prefill-sampled first token that IS eos must not decode:
+            # the sync path finishes it host-side before install; here the
+            # device sees it, so install the slot inactive (the host
+            # harvest then retires it on the next packed read)
+            live = (sel[0] != vals["eos"]) | (vals["eos"] < 0)
+            return (
+                lengths.at[i].set(vals["prompt_len"], **kw),
+                last.at[i].set(sel[0], **kw),
+                active.at[i].set(live, **kw),
+                produced.at[i].set(1, **kw),
+                max_new.at[i].set(vals["max_new"], **kw),
+                eos.at[i].set(vals["eos"], **kw),
+                temps.at[i].set(vals["temp"], **kw),
+                top_k.at[i].set(vals["top_k"], **kw),
+                top_p.at[i].set(vals["top_p"], **kw),
+                min_p.at[i].set(vals["min_p"], **kw),
+                firsts_buf.at[:, i].set(sel, **kw),
+            )
+
         # page-pool writes donate the pool: an un-donated eager scatter
         # would materialise a full copy of the (possibly multi-GiB) pages
         # on every admission
         self._write_pages = jax.jit(write_prefill_pages,
                                     donate_argnums=(0, 1))
         self._install = _install
+        self._install_first = _install_first
         self._prefill = _prefill
         self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
@@ -725,12 +772,11 @@ class ContinuousEngine:
             return False
         return True
 
-    def _install_device(self, rows: List[Dict[str, Any]]) -> None:
-        """Install device state for a round of admissions in one dispatch;
-        ``rows`` entries carry slot + per-slot fields. Padded to a pow2
-        bucket with out-of-range slots (dropped by the scatter)."""
-        if not rows:
-            return
+    def _pack_rows(self, rows: List[Dict[str, Any]]):
+        """Pad an admission round's rows to a pow2 bucket of device-ready
+        arrays (shared by the sync and deferred installs). Pad entries
+        hold ``max_slots`` and fall out of the scatters' range. Also
+        updates the host length mirror."""
         bb = 1 << (len(rows) - 1).bit_length()
         slots = np.full((bb,), self.max_slots, np.int32)   # pad -> dropped
         f = {k: np.zeros((bb,), dt) for k, dt in (
@@ -743,13 +789,42 @@ class ContinuousEngine:
             self._lengths_host[r["slot"]] = r["prompt_len"]
             for k in f:
                 f[k][i] = r[k]
+        return bb, jnp.asarray(slots), {k: jnp.asarray(v)
+                                        for k, v in f.items()}
+
+    def _install_device(self, rows: List[Dict[str, Any]]) -> None:
+        """Install device state for a round of admissions in one dispatch;
+        ``rows`` entries carry slot + per-slot fields."""
+        if not rows:
+            return
+        _bb, slots, vals = self._pack_rows(rows)
         (self._lengths, self._last, self._active, self._produced,
          self._max_new, self._eos, self._temps, self._top_k,
          self._top_p, self._min_p) = self._install(
             self._lengths, self._last, self._active, self._produced,
             self._max_new, self._eos, self._temps, self._top_k,
-            self._top_p, self._min_p, jnp.asarray(slots),
-            {k: jnp.asarray(v) for k, v in f.items()},
+            self._top_p, self._min_p, slots, vals,
+        )
+
+    def _install_device_first(self, rows: List[Dict[str, Any]],
+                              cols: List[int], first_dev) -> None:
+        """Deferred-admission install: device state comes up exactly as in
+        ``_install_device`` but the first tokens are wired from the
+        prefill output ``first_dev`` (device) — column ``cols[i]`` for
+        ``rows[i]`` (``vals["first"]`` goes unused) — and parked in
+        ``_firsts_dev`` for the next packed read. No host round trip."""
+        if not rows:
+            return
+        bb, slots, vals = self._pack_rows(rows)
+        cols_np = np.zeros((bb,), np.int32)
+        cols_np[: len(cols)] = cols
+        (self._lengths, self._last, self._active, self._produced,
+         self._max_new, self._eos, self._temps, self._top_k,
+         self._top_p, self._min_p, self._firsts_dev) = self._install_first(
+            self._lengths, self._last, self._active, self._produced,
+            self._max_new, self._eos, self._temps, self._top_k,
+            self._top_p, self._min_p, self._firsts_dev,
+            slots, vals, first_dev, jnp.asarray(cols_np),
         )
 
     @staticmethod
@@ -910,11 +985,45 @@ class ContinuousEngine:
             jnp.asarray(table_rows), seq_dev,
         )
         self.kv.swap(kp, vp)
+        # deferred admission: under decode pressure (≥1/4 of slots live),
+        # skip the blocking first-token read — install the firsts device-
+        # side and let the host harvest them from the NEXT chunk's packed
+        # output. Saves a full host round trip per admission round while
+        # the device would otherwise idle. Light load keeps the sync path
+        # (first token delivered ~a chunk earlier). max_new<=1 requests
+        # must stop BEFORE decoding, which needs the token on host — sync.
+        defer = (self._defer_admit
+                 and len(self._slots) * 4 >= self.max_slots
+                 and all(r.max_new_tokens > 1 for r, *_ in batch))
+        if defer:
+            self.prefill_stats.add(time.perf_counter() - t0)  # dispatch only
+            rows: List[Dict[str, Any]] = []
+            cols: List[int] = []
+            for i, (req, cb, slot, prompt, t_submit, full) in enumerate(batch):
+                if full is not None:
+                    # chunked first-chunk rows take the sync machinery
+                    # either way (their sample is discarded) — they are
+                    # not deferred admissions
+                    self._start_chunked(req, cb, slot, full, t_submit,
+                                        done=len(prompt))
+                    continue
+                if self.prefix_cache:
+                    self.kv.register_prefix(slot, prompt)
+                self._total_prompt_tokens += len(prompt)
+                state = _Slot(req, slot, len(prompt), cb)
+                state.first_pending = True
+                state.admitted_at = t_submit
+                self._slots[slot] = state
+                rows.append(self._slot_row(req, slot, len(prompt), 0))
+                cols.append(i)
+            self._deferred_admissions += len(rows)
+            self._install_device_first(rows, cols, first_dev)
+            return
         fp = np.asarray(first_dev)                 # [2, bb]: tokens; lp bits
         firsts = fp[0]
         first_lps = fp[1].view(np.float32)
         self.prefill_stats.add(time.perf_counter() - t0)   # once per dispatch
-        rows: List[Dict[str, Any]] = []
+        rows = []
         for i, (req, cb, slot, prompt, t_submit, full) in enumerate(batch):
             if full is not None:
                 # first chunk of a chunked admission: its KV pages are
@@ -1111,6 +1220,16 @@ class ContinuousEngine:
         state = self._slots.pop(slot)
         self.kv.free_slot(slot)
         req = state.request
+        if state.first_pending:
+            # retired before any packed read delivered its deferred first
+            # token (e.g. capacity-retire on the very next step): rescue
+            # it with a direct read — rare, so the round trip is fine
+            state.first_pending = False
+            fp = np.asarray(self._firsts_dev[:, slot])
+            state.tokens.insert(0, int(fp[0]))
+            state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
+            state.first_token_at = time.perf_counter()
+            self.ttft_stats.add(state.first_token_at - state.admitted_at)
         toks, stopped = trim_at_stops(state.tokens, req)
         if stopped:
             reason = "stop"
@@ -1196,7 +1315,7 @@ class ContinuousEngine:
             self.params, self.kv.k_pages, self.kv.v_pages,
             self._lengths, self._last, self._active, self._produced,
             self.kv.page_table, cap, self._max_new, sampling, self._eos,
-            kc, n_steps=n_steps, n_ctx_pages=mpb,
+            self._firsts_dev, kc, n_steps=n_steps, n_ctx_pages=mpb,
         )
         kp, vp, self._lengths, self._last, self._active, self._produced = carry
         self.kv.swap(kp, vp)
@@ -1224,8 +1343,10 @@ class ContinuousEngine:
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
         lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)
-        active_np = packed_np[-2].astype(bool)
-        lengths_row = packed_np[-1].astype(np.int32)
+        active_np = packed_np[2 * n_steps].astype(bool)
+        lengths_row = packed_np[2 * n_steps + 1].astype(np.int32)
+        firsts_tok = packed_np[2 * n_steps + 2]          # deferred admissions
+        firsts_lp = packed_np[2 * n_steps + 3].view(np.float32)
         # sync: dispatch-to-ready per chunk. defer: dispatch time would
         # span a whole unrelated host step (samples overlapping wall
         # clock), so record the actual blocking WAIT — the residue the
@@ -1241,6 +1362,15 @@ class ContinuousEngine:
             col = toks_np[:, slot]
             lcol = lps_np[:, slot]
             prev = len(state.tokens)           # first index not yet stop-checked
+            if state.first_pending:
+                # harvest the deferred first token (prev stays 0: the stop
+                # scan below must cover it). TTFT is stamped at DELIVERY —
+                # the honest consumer-visible time under deferral.
+                state.first_pending = False
+                state.tokens.append(int(firsts_tok[slot]))
+                state.logprobs.append(float(firsts_lp[slot]))
+                state.first_token_at = time.perf_counter()
+                self.ttft_stats.add(state.first_token_at - state.admitted_at)
             for si in range(col.shape[0]):
                 if col[si] >= 0:
                     state.tokens.append(int(col[si]))
@@ -1414,6 +1544,7 @@ class ContinuousEngine:
             "prefix_hit_admissions": self._prefix_hit_admissions,
             "prefilling_slots": len(self._prefilling),
             "chunked_admissions": self._chunked_admissions,
+            "deferred_admissions": self._deferred_admissions,
             # serving metrics the reference's mock could never know
             # (SURVEY.md §5): per-request TTFT from submit, and mean decode
             # batch occupancy (live slots / max_slots per engine step)

@@ -1,0 +1,181 @@
+"""Mosaic (Pallas-TPU) matmul with in-register int4 unpack.
+
+Closes the one SURVEY §2.2 "Pallas where XLA is insufficient" obligation
+left open in round 3: packed-int4 weights through XLA's einsum decode at
+1,584 tok/s vs int8's 3,661 at the 8B bs64 rung, because XLA materializes
+the unpacked int8 operand in HBM — the decode step then streams the 2-byte
+traffic AND the packed read. This kernel keeps the weight packed in HBM
+and VMEM and unpacks nibbles in registers on the way into the MXU feed, so
+HBM sees only the 0.5-byte/weight stream. (The reference has no analogue:
+its "model" is an asyncio sleep, ``src/mock_models/fake_model.py:47``.)
+
+Layout contract (``ops.quant.quantize_weight``): a ``[K, N]`` weight packs
+SPLIT-HALF along the contraction axis into ``[K/2, N]`` int8 — source row
+``k < K/2`` in the low nibble of byte row ``k``, row ``K/2 + k`` in the
+high nibble. The matmul then decomposes into two contiguous-slice dots,
+
+    y = x[:, :K/2] @ lo(P) + x[:, K/2:] @ hi(P),    P = packed bytes
+
+with no stride-2 gather anywhere (an interleaved layout would need one on
+either the activations or the unpacked weight — both Mosaic-hostile).
+
+Grid: ``(M/bm, N/bn, K2/bk)``, k innermost ("arbitrary"), accumulating in
+a VMEM f32 scratch; weight blocks stream exactly once per (m, n) tile, so
+a bs64 decode step streams each weight byte exactly once. Nibble unpack is
+3 VPU int32 ops + 2 converts per byte, overlapped with the MXU by Mosaic's
+usual software pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# kernel dispatch mode (read at TRACE time):
+#   auto      — use the kernel on a single-device TPU process (the bench /
+#               single-chip serving deploys); XLA einsum path elsewhere.
+#               Multi-device processes keep the XLA path because a
+#               pallas_call is an opaque unit to GSPMD — tp-sharded int4
+#               weights would force a gather.
+#   on        — always (interpreted off-TPU: CPU tests of the kernel math)
+#   off       — never
+_MODE = os.environ.get("INT4_MATMUL_KERNEL", "auto")
+
+
+def set_kernel_mode(mode: str) -> None:
+    """"auto" | "on" | "off" — see module docstring."""
+    global _MODE
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"bad int4 kernel mode {mode!r}")
+    _MODE = mode
+
+
+def _block_of(size: int, candidates: Tuple[int, ...]) -> Optional[int]:
+    for b in candidates:
+        if size % b == 0:
+            return b
+    return None
+
+
+def kernel_wants(pattern: str, x, w) -> bool:
+    """True when the Mosaic kernel should take this einsum: mode allows
+    it, the weight is an unstacked ``[K/2, N]`` payload contracted on its
+    packed axis, and the shapes tile cleanly (K/2 and N divisible by the
+    block candidates). Everything else falls back to the XLA path."""
+    if _MODE == "off":
+        return False
+    if _MODE == "auto" and not (jax.default_backend() == "tpu"
+                                and len(jax.devices()) == 1):
+        return False
+    if w.q.ndim != 2 or w.pack_axis % w.q.ndim != 0:
+        return False                    # payload must be packed on axis 0
+    lhs, out = pattern.split("->")
+    xs, ws = lhs.split(",")
+    if len(ws) != 2 or not xs.endswith(ws[0]) or ws[0] in out \
+            or ws[1] not in out:
+        return False     # contraction must be x's LAST axis and w's axis 0
+    if not out.endswith(ws[1]) or xs.replace(ws[0], "") + ws[1] != out:
+        return False                    # out = x batch dims + N
+    k2, n = w.q.shape
+    return (_block_of(k2, _K_BLOCKS) is not None
+            and _block_of(n, _N_BLOCKS) is not None)
+
+
+# preference order measured on v5e at the 8B decode shape ([64,4096] @
+# [4096,14336]): bk1024/bn2048 runs 24.9 us/iter vs 82.5 at bk512/bn512 —
+# bigger blocks amortize the per-block VPU unpack + loop overhead; the
+# unpack STYLE (int32 shifts vs xor-bias) measured within noise of itself.
+# int8-typed shifts don't compile on this Mosaic — keep the int32 widen.
+_K_BLOCKS = (1024, 512, 256, 128)
+_N_BLOCKS = (2048, 1024, 512, 256, 128)
+
+
+def _kernel(xlo_ref, xhi_ref, p_ref, s_ref, o_ref, acc_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # sign-extend both nibbles in int32 registers; int4 values are exact
+    # in bf16, so the MXU sees ordinary bf16 operands
+    p = p_ref[...].astype(jnp.int32)
+    lo = jax.lax.shift_right_arithmetic(jax.lax.shift_left(p, 28), 28)
+    hi = jax.lax.shift_right_arithmetic(p, 4)
+    dt = xlo_ref.dtype
+    acc_ref[...] += (
+        jnp.dot(xlo_ref[...], lo.astype(dt),
+                preferred_element_type=jnp.float32)
+        + jnp.dot(xhi_ref[...], hi.astype(dt),
+                  preferred_element_type=jnp.float32))
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _int4_matmul_2d(x, packed, scale, *, interpret: bool = False):
+    """``[M, K] @ unpack([K/2, N]) * scale -> [M, N]`` (dtype of x)."""
+    m, kdim = x.shape
+    k2, n = packed.shape
+    if kdim != 2 * k2:
+        raise ValueError(f"x K={kdim} vs packed K/2={k2}")
+    bk = _block_of(k2, _K_BLOCKS)
+    bn = _block_of(n, _N_BLOCKS)
+    if bk is None or bn is None:
+        raise ValueError(f"untileable shapes K/2={k2} N={n}")
+    # activations tile at (16, 128) for bf16 — pad M up, slice back after.
+    # bm tops out at 128 to keep the f32 accumulator block ≤1 MB alongside
+    # the 2 MB double-buffered weight blocks (VMEM is ~16 MB)
+    bm = _block_of(m, (128, 64, 32, 16))
+    if bm is None:
+        bm = min(-(-m // 16) * 16, 128)
+        x = jnp.pad(x, ((0, -m % bm), (0, 0)))
+    mp = x.shape[0]
+
+    grid = (mp // bm, n // bn, k2 // bk)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),      # x low half
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),      # x high half
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),      # packed W
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),       # out scale
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # the int32 nibble-widening temporaries ([bk, bn] lo+hi) top
+            # 16 MB at the prefill tile (bm=128, bn=2048) — past the
+            # default scoped-vmem limit but well inside v5e's 128 MB
+            # physical VMEM (measured: compiles + runs at 64 MB)
+            vmem_limit_bytes=64 * 1024 * 1024),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * n * kdim,
+            bytes_accessed=(k2 * n) + 2 * mp * kdim * (n // bn)
+                           + mp * n * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x[:, :k2], x[:, k2:], packed, scale.reshape(1, n))
+    return out[:m] if mp != m else out
+
+
+def int4_einsum_kernel(pattern: str, x, w):
+    """``matmul_any``'s kernel path: flatten x's batch dims to M, run the
+    2-D kernel, restore. ``kernel_wants(pattern, x, w)`` must hold."""
+    k2, n = w.q.shape
+    lead = x.shape[:-1]
+    xm = x.reshape(-1, x.shape[-1])
+    y = _int4_matmul_2d(xm, w.q, w.s.astype(jnp.float32),
+                        interpret=jax.default_backend() != "tpu")
+    return y.reshape(lead + (n,))

@@ -50,7 +50,11 @@ class QuantizedTensor:
     ``bits=8`` (default): ``q`` is int8, same shape as the original weight;
     dequant = q * s. ``bits=4``: ``q`` is int8 holding TWO int4 values per
     byte, packed along ``pack_axis`` (the matmul's contraction axis, halved
-    in shape) — even source indices in the low nibble, odd in the high.
+    in shape) — SPLIT-HALF layout: source index ``k < K/2`` in the low
+    nibble of byte ``k``, source index ``K/2 + k`` in the high nibble.
+    (Round 3 packed even/odd interleaved; split-half lets the Mosaic
+    matmul kernel unpack with two contiguous activation slices instead of
+    a stride-2 gather — ``ops/int4_matmul.py``.)
     ``bits``/``pack_axis`` are pytree aux data (static), so quantized trees
     flow through jit/scan/shard machinery unchanged.
     """
@@ -92,7 +96,7 @@ class QuantizedTensor:
         a = self.pack_axis % self.q.ndim
         lo = jnp.right_shift(jnp.left_shift(self.q, 4), 4)
         hi = jnp.right_shift(self.q, 4)
-        return jnp.stack([lo, hi], axis=a + 1).reshape(self.shape)
+        return jnp.concatenate([lo, hi], axis=a)
 
     def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
         q = self._unpacked_int8() if self.bits == 4 else self.q
@@ -105,8 +109,9 @@ def quantize_weight(w: jnp.ndarray, reduce_axes: Sequence[int],
     axes; remaining axes are output/batch channels, one scale each).
 
     ``bits=4`` halves the HBM weight stream again: values in [-7, 7]
-    (symmetric — -8 is unused), two per byte, packed along the FIRST
-    reduce axis (must be even-sized)."""
+    (symmetric — -8 is unused), two per byte, split-half packed along the
+    FIRST reduce axis (must be even-sized): the axis's first half in the
+    low nibbles, second half in the high."""
     w32 = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=tuple(reduce_axes), keepdims=True)
     if bits == 8:
@@ -120,13 +125,41 @@ def quantize_weight(w: jnp.ndarray, reduce_axes: Sequence[int],
         raise ValueError(f"int4 pack axis {a} has odd size {w32.shape[a]}")
     scale = jnp.maximum(amax, 1e-8) / 7.0
     q = jnp.clip(jnp.round(w32 / scale), -7, 7).astype(jnp.int8)
-    even = jax.lax.slice_in_dim(q, 0, q.shape[a], stride=2, axis=a)
-    odd = jax.lax.slice_in_dim(q, 1, q.shape[a], stride=2, axis=a)
+    half = q.shape[a] // 2
+    lo = jax.lax.slice_in_dim(q, 0, half, axis=a)
+    hi = jax.lax.slice_in_dim(q, half, 2 * half, axis=a)
     packed = jax.lax.bitcast_convert_type(
-        (even.astype(jnp.uint8) & 0xF) | (odd.astype(jnp.uint8) << 4),
+        (lo.astype(jnp.uint8) & 0xF) | (hi.astype(jnp.uint8) << 4),
         jnp.int8)
     return QuantizedTensor(q=packed, s=scale, bits=4,
                            pack_axis=a - w32.ndim)
+
+
+def repack_int4_interleaved_to_split(qt: QuantizedTensor) -> QuantizedTensor:
+    """Convert a pre-r4 int4 payload (even/odd interleave: source index
+    ``2k`` in byte ``k``'s low nibble, ``2k+1`` in its high) to the
+    current split-half layout. Checkpoints persist raw packed bytes, so
+    restore uses the saved layout marker to call this exactly once for
+    old files (utils/checkpoint.py) — without it every weight matrix
+    would be silently row-permuted."""
+    if qt.bits != 4:
+        return qt
+    a = qt.pack_axis % qt.q.ndim
+    even = jnp.right_shift(jnp.left_shift(qt.q, 4), 4)
+    odd = jnp.right_shift(qt.q, 4)
+    full = jnp.stack([even, odd], axis=a + 1).reshape(qt.shape)
+    half = full.shape[a] // 2
+    lo = jax.lax.slice_in_dim(full, 0, half, axis=a)
+    hi = jax.lax.slice_in_dim(full, half, 2 * half, axis=a)
+    packed = jax.lax.bitcast_convert_type(
+        (lo.astype(jnp.uint8) & 0xF) | (hi.astype(jnp.uint8) << 4),
+        jnp.int8)
+    return dataclasses.replace(qt, q=packed)
+
+
+# split-half int4 layout version persisted with checkpoints (bits=4 only):
+# absent = pre-r4 even/odd interleave, 1 = split-half
+INT4_LAYOUT_SPLIT_HALF = 1
 
 
 def _einsum_int4(pattern: str, x: jnp.ndarray,
@@ -145,23 +178,24 @@ def _einsum_int4(pattern: str, x: jnp.ndarray,
             f"int4 matmul needs exactly one contraction axis in {pattern!r}")
     c = contract[0]
     assert "P" not in pattern and "Q" not in pattern
-    new = f"{xs.replace(c, c + 'P')},{ws.replace(c, c + 'P')}->{out}"
+    new = f"{xs.replace(c, 'P' + c)},{ws.replace(c, 'P' + c)}->{out}"
     ax_w = ws.index(c)
     if ax_w != w.pack_axis % w.q.ndim:
         raise ValueError(
             f"pattern {pattern!r} contracts axis {ax_w} but the int4 "
             f"payload is packed along axis {w.pack_axis % w.q.ndim}")
-    # x: split the contraction axis into (half, 2) — even index -> low
-    # nibble, odd -> high, matching quantize_weight's packing
+    # x: split the contraction axis into (2, half) — the axis's first
+    # half rides the low nibbles, the second half the high, matching
+    # quantize_weight's split-half packing
     tail = xs.replace("...", "")
     ax_x = x.ndim - len(tail) + tail.index(c)
-    xr = x.reshape(x.shape[:ax_x] + (x.shape[ax_x] // 2, 2)
+    xr = x.reshape(x.shape[:ax_x] + (2, x.shape[ax_x] // 2)
                    + x.shape[ax_x + 1:])
-    # w: broadcast the packed byte over a nibble axis; shift [4, 0] then
-    # arithmetic >> 4 sign-extends each nibble
-    qb = jnp.expand_dims(w.q, ax_w + 1)
+    # w: broadcast the packed byte over a leading nibble axis; shift
+    # [4, 0] then arithmetic >> 4 sign-extends each nibble
+    qb = jnp.expand_dims(w.q, ax_w)
     shift_shape = [1] * qb.ndim
-    shift_shape[ax_w + 1] = 2
+    shift_shape[ax_w] = 2
     shifts = jnp.asarray([4, 0], jnp.int8).reshape(shift_shape)
     wu = jnp.right_shift(jnp.left_shift(qb, shifts), 4).astype(x.dtype)
     y = jnp.einsum(new, xr, wu)
@@ -179,6 +213,10 @@ def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """
     if isinstance(w, QuantizedTensor):
         if w.bits == 4:
+            from .int4_matmul import int4_einsum_kernel, kernel_wants
+
+            if kernel_wants(pattern, x, w):
+                return int4_einsum_kernel(pattern, x, w)
             return _einsum_int4(pattern, x, w)
         y = jnp.einsum(pattern, x, w.q.astype(x.dtype))
         return y * _out_scale(w.s).astype(y.dtype)
@@ -282,11 +320,11 @@ def random_quantized_params(spec, key, w_std: float = 0.02,
                     f"int4 pack axis {a} has odd size {leaf.shape[a]}")
             half = tuple(d // 2 if i == a else d
                          for i, d in enumerate(leaf.shape))
-            even = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
-            odd = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
+            lo = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
+            hi = jax.random.randint(nk(), half, -7, 8, dtype=jnp.int8)
             packed = jax.lax.bitcast_convert_type(
-                (even.astype(jnp.uint8) & 0xF)
-                | (odd.astype(jnp.uint8) << 4), jnp.int8)
+                (lo.astype(jnp.uint8) & 0xF)
+                | (hi.astype(jnp.uint8) << 4), jnp.int8)
             std4 = (7 * 8 / 3.0) ** 0.5
             return QuantizedTensor(
                 q=packed, s=jnp.full(s_shape, w_std / std4, jnp.float32),

@@ -41,9 +41,17 @@ def _encode_tree(tree: Any) -> Any:
             # bits/pack_axis persist as tiny arrays (orbax stores arrays):
             # an int4 checkpoint restored as default-int8 would be
             # silently mis-shaped
-            return {_QUANT_MARKER: np.int8(1), "q": node.q, "s": node.s,
-                    "bits": np.int32(node.bits),
-                    "pack_axis": np.int32(node.pack_axis)}
+            out = {_QUANT_MARKER: np.int8(1), "q": node.q, "s": node.s,
+                   "bits": np.int32(node.bits),
+                   "pack_axis": np.int32(node.pack_axis)}
+            if node.bits == 4:
+                # layout version: split-half packing (r4). Old files
+                # without it are even/odd interleaved and get repacked
+                # on restore
+                from ..ops.quant import INT4_LAYOUT_SPLIT_HALF
+
+                out["layout"] = np.int32(INT4_LAYOUT_SPLIT_HALF)
+            return out
         if isinstance(node, dict):
             return {k: enc(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
@@ -60,10 +68,18 @@ def _decode_tree(tree: Any) -> Any:
         if isinstance(node, dict):
             if _QUANT_MARKER in node:
                 # pre-int4 checkpoints carry no bits field -> int8
-                return QuantizedTensor(
+                qt = QuantizedTensor(
                     q=node["q"], s=node["s"],
                     bits=int(node.get("bits", 8)),
                     pack_axis=int(node.get("pack_axis", 0)))
+                if qt.bits == 4 and "layout" not in node:
+                    # pre-r4 int4 files are even/odd interleaved; the
+                    # current code (XLA fallback AND the Mosaic kernel)
+                    # reads split-half — repack once here
+                    from ..ops.quant import repack_int4_interleaved_to_split
+
+                    qt = repack_int4_interleaved_to_split(qt)
+                return qt
             return {k: dec(v) for k, v in node.items()}
         if isinstance(node, (list, tuple)):
             return type(node)(dec(v) for v in node)

@@ -244,3 +244,101 @@ def test_defer_sync_requires_fully_backed_pool():
 
     with pytest.raises(ValueError, match="fully backed"):
         ContinuousEngine(SPEC, config=_cfg(defer_sync=True, num_pages=8))
+
+
+def test_deferred_admission_parity_and_ttft():
+    """Under decode pressure the deferred-admission path (first token
+    installed device-side, harvested from the next chunk's packed read)
+    must produce exactly the tokens of the sync path, with TTFT stamped
+    and >=1 token per result."""
+    import jax
+
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(3))
+    rs = np.random.RandomState(5)
+    reqs = _reqs(rs, 4, max_new=10)
+
+    def run(defer: bool):
+        eng = ContinuousEngine(SPEC, params=params,
+                               config=_cfg(defer_admission=defer))
+        eng.submit(reqs[0])
+        while not eng._slots:                  # r0 live -> pressure >= 1/4
+            eng.step()
+        for r in reqs[1:]:
+            eng.submit(r)
+        eng.step()                             # admission round for r1..r3
+        if defer:
+            assert eng.get_metrics()["deferred_admissions"] >= 3, \
+                "deferred path did not engage"
+        out = {r.request_id: r for r in eng.run_until_idle()}
+        assert not any(getattr(s, "first_pending", False)
+                       for s in eng._slots.values())
+        return out
+
+    got = run(True)
+    ref = run(False)
+    assert set(got) == set(ref)
+    for rid in ref:
+        assert got[rid].tokens == ref[rid].tokens, rid
+        assert len(got[rid].tokens) >= 1
+        assert got[rid].ttft_s > 0
+
+
+def test_deferred_admission_single_token_request_falls_back():
+    """max_new_tokens=1 must resolve with exactly one token even when the
+    engine is busy (the deferred path cannot stop before decoding, so the
+    admission round takes the sync path)."""
+    import jax
+
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(3))
+    rs = np.random.RandomState(6)
+    eng = ContinuousEngine(SPEC, params=params, config=_cfg())
+    eng.submit(_reqs(rs, 1, max_new=12)[0])
+    while not eng._slots:
+        eng.step()
+    one = GenerationRequest(prompt=[5, 6, 7], max_new_tokens=1,
+                            temperature=0.0, request_id="one")
+    eng.submit(one)
+    out = {r.request_id: r for r in eng.run_until_idle()}
+    assert len(out["one"].tokens) == 1
+
+
+def test_deferred_admission_eos_first_token_stops_clean():
+    """A deferred admission whose prefill-sampled first token IS eos must
+    resolve as a stop with just that token — installed inactive on device
+    (no dead decode steps) and retired at the next packed read."""
+    import jax
+
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(SPEC, jax.random.key(3))
+    rs = np.random.RandomState(7)
+    busy = _reqs(rs, 1, max_new=12)[0]
+    probe = GenerationRequest(prompt=[9, 8, 7], max_new_tokens=6,
+                              temperature=0.0, request_id="p")
+
+    # discover the greedy first token for this prompt
+    eng0 = ContinuousEngine(SPEC, params=params, config=_cfg())
+    first = eng0.generate([probe])[0].tokens[0]
+
+    def run(defer: bool):
+        eng = ContinuousEngine(SPEC, params=params,
+                               config=_cfg(defer_admission=defer))
+        eng.submit(GenerationRequest(prompt=busy.prompt, max_new_tokens=12,
+                                     temperature=0.0, request_id="busy"))
+        while not eng._slots:
+            eng.step()
+        eng.submit(GenerationRequest(prompt=[9, 8, 7], max_new_tokens=6,
+                                     temperature=0.0, eos_id=first,
+                                     request_id="p"))
+        out = {r.request_id: r for r in eng.run_until_idle()}
+        if defer:
+            assert eng.get_metrics()["deferred_admissions"] >= 1
+        return out["p"]
+
+    got, ref = run(True), run(False)
+    assert got.finish_reason == ref.finish_reason == "stop"
+    assert got.tokens == ref.tokens

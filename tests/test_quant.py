@@ -254,3 +254,34 @@ def test_int4_engine_generates_and_matches_dequantized_engine():
     t4 = e4.generate(reqs())[0].tokens
     td = ed.generate(reqs())[0].tokens
     assert t4 == td and len(t4) == 8
+
+
+def test_int4_interleaved_checkpoint_repacks_on_restore():
+    """Pre-r4 int4 checkpoints pack even/odd interleaved; the restore
+    codec must repack them to the current split-half layout (keyed by the
+    absent layout marker) so old files keep decoding correctly."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.ops.quant import QuantizedTensor
+    from distributed_inference_engine_tpu.utils.checkpoint import (
+        _decode_tree,
+        _encode_tree,
+    )
+
+    rs = np.random.RandomState(3)
+    vals = rs.randint(-7, 8, size=(8, 6)).astype(np.int8)   # true int4 values
+    # old layout: byte k holds (vals[2k] lo, vals[2k+1] hi)
+    old_packed = ((vals[0::2].astype(np.uint8) & 0xF)
+                  | (vals[1::2].astype(np.uint8) << 4)).view(np.int8)
+    s = np.full((1, 6), 0.5, np.float32)
+    node = {"__quantized_tensor__": np.int8(1), "q": jnp.asarray(old_packed),
+            "s": jnp.asarray(s), "bits": np.int32(4),
+            "pack_axis": np.int32(-2)}            # no "layout": pre-r4 file
+    qt = _decode_tree({"w": dict(node)})["w"]
+    np.testing.assert_array_equal(np.asarray(qt._unpacked_int8()), vals)
+
+    # current files carry the marker and round-trip WITHOUT repacking
+    enc = _encode_tree({"w": qt})["w"]
+    assert int(enc["layout"]) == 1
+    qt2 = _decode_tree({"w": enc})["w"]
+    np.testing.assert_array_equal(np.asarray(qt2._unpacked_int8()), vals)
