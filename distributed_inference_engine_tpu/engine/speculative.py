@@ -109,6 +109,42 @@ def truncated_draft(spec: ModelSpec, params: Params,
     return d_spec, d_params
 
 
+def scale_top_blocks(spec: ModelSpec, params: Params, n_shared: int,
+                     eps: float) -> Params:
+    """ε-noise target for acceptance sweeps: blocks ``>= n_shared`` get
+    their residual-writing weights (``wo``, ``w_down``, and their biases)
+    scaled by ``eps``, so each such block perturbs the residual stream by
+    O(eps) instead of O(1).
+
+    Paired with ``truncated_draft(spec, params, n_shared)`` this gives a
+    CHEAP draft whose agreement with the target is a measurable function
+    of eps: at eps=0 the top blocks are exact identities (zero residual
+    contribution; embeddings/final norm/lm head shared), so target logits
+    equal draft logits and greedy acceptance is exactly 1 — the
+    machinery-ceiling point; eps→1 recovers the unrelated-top-layers
+    regime where acceptance collapses. Sweeping eps traces tok/s vs
+    acceptance on hardware (examples/spec_sweep.py) with no second param
+    set: quantized trees scale only the per-channel scale arrays (the
+    int8/int4 payload is shared).
+    """
+    from ..ops.quant import QuantizedTensor
+
+    L = spec.n_layers
+    if not 0 < n_shared < L:
+        raise ValueError(f"n_shared {n_shared} not in (0, {L})")
+    blocks = dict(params["blocks"])
+    for name in ("wo", "w_down", "bo", "b_down"):
+        w = blocks.get(name)
+        if w is None:
+            continue
+        if isinstance(w, QuantizedTensor):
+            blocks[name] = dataclasses.replace(
+                w, s=w.s.at[n_shared:].multiply(eps))
+        else:
+            blocks[name] = w.at[n_shared:].multiply(eps)
+    return {**params, "blocks": blocks}
+
+
 class SpeculativeEngine:
     """Engine-interface implementation (same ``generate`` contract as
     ``engine.Engine``) that decodes with draft-model speculation."""
@@ -122,6 +158,15 @@ class SpeculativeEngine:
         config: Optional[EngineConfig] = None,
         seed: int = 0,
         speculate_k: int = 4,
+        rounds_per_call: int = 4,   # speculative rounds per device
+                            # dispatch (lax.scan): the host reads ONE
+                            # packed buffer per R rounds instead of per
+                            # round — on a tunnelled chip each read is a
+                            # ~100 ms round trip, which at r3's R=1
+                            # swamped the round compute and hid any
+                            # possible speculation win. Host-side stop
+                            # detection coarsens to chunk boundaries
+                            # (device eos handling stays per-round).
         shard_fn=None,      # target params -> mesh-placed (parallel/sharding)
         kv_sharding=None,   # NamedSharding for the dense [L,B,S,Hkv,Dh]
                             # target caches (ModelShardings.kv); the DRAFT is
@@ -139,7 +184,10 @@ class SpeculativeEngine:
             )
         if speculate_k < 1:
             raise ValueError("speculate_k must be >= 1")
+        if rounds_per_call < 1:
+            raise ValueError("rounds_per_call must be >= 1")
         self.k = int(speculate_k)
+        self.rounds_per_call = int(rounds_per_call)
         self.config = config or EngineConfig()
         if params is None:
             params = init_params(spec, jax.random.key(seed))
@@ -187,10 +235,9 @@ class SpeculativeEngine:
                 [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
             return packed, tks, tvs, dks, dvs
 
-        @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
-        def _round(pt, pd, tck, tcv, dck, dcv,
-                   lengths, last, active, produced,
-                   max_new, eos_ids, sampling, key):
+        def _round_core(pt, pd, tck, tcv, dck, dcv,
+                        lengths, last, active, produced,
+                        max_new, eos_ids, sampling, key):
             """One speculative round for every slot. Shapes:
             tck/tcv [L,B,S,..] target cache; dck/dcv draft cache;
             per-slot int32/bool vectors. Returns updated state + emitted
@@ -313,8 +360,32 @@ class SpeculativeEngine:
             return (tck, tcv, dck, dcv, lengths, last,
                     active, produced, packed)
 
+        @partial(jax.jit, static_argnames=("rounds",),
+                 donate_argnums=(2, 3, 4, 5))
+        def _rounds(pt, pd, tck, tcv, dck, dcv, lengths, last, active,
+                    produced, max_new, eos_ids, sampling, key,
+                    rounds: int):
+            """``rounds`` speculative rounds in ONE dispatch; the host
+            reads one stacked packed buffer per call. Slots that finish
+            mid-chunk stay frozen for the remaining rounds (emitted=-1),
+            bounding the wasted compute at rounds-1 masked rounds."""
+
+            def body(carry, kr):
+                tck, tcv, dck, dcv, lengths, last, active, produced = carry
+                (tck, tcv, dck, dcv, lengths, last, active, produced,
+                 packed) = _round_core(
+                    pt, pd, tck, tcv, dck, dcv, lengths, last, active,
+                    produced, max_new, eos_ids, sampling, kr)
+                return ((tck, tcv, dck, dcv, lengths, last, active,
+                         produced), packed)
+
+            carry, packs = jax.lax.scan(
+                body, (tck, tcv, dck, dcv, lengths, last, active, produced),
+                jax.random.split(key, rounds))
+            return carry, packs                      # [R, B, 2(k+1)+2]
+
         self._prefill_both = _prefill_both
-        self._round = _round
+        self._rounds = _rounds
 
         # metrics
         self.prefill_stats = LatencyStats()
@@ -430,33 +501,40 @@ class SpeculativeEngine:
         if stopped_rows and act_host.any():
             active = active.at[
                 jnp.asarray(stopped_rows, jnp.int32)].set(False)
+        R = self.rounds_per_call
         while act_host.any():
             self._rng, kr = jax.random.split(self._rng)
-            (tck, tcv, dck, dcv, lengths, last, active,
-             produced, packed) = self._round(
+            ((tck, tcv, dck, dcv, lengths, last, active, produced),
+             packs) = self._rounds(
                 self.params, self.draft_params, tck, tcv, dck, dcv,
                 lengths, last, active, produced,
-                max_new_j, eos_j, sampling, kr,
+                max_new_j, eos_j, sampling, kr, rounds=R,
             )
-            pk = np.asarray(packed)     # ONE blocking read per round
+            pks = np.asarray(packs)     # ONE blocking read per R rounds
             k1 = self.k + 1
-            em = pk[:, :k1]
-            lps = np.ascontiguousarray(pk[:, k1: 2 * k1]).view(np.float32)
-            n_acc_np = pk[:, 2 * k1]
-            act_host = pk[:, 2 * k1 + 1].astype(bool)
-            live = int((em[:, 0] >= 0).sum())
-            self._total_rounds += 1
-            self._total_accepted += int(n_acc_np[em[:, 0] >= 0].sum())
-            self._total_proposed += self.k * live
-            for i in range(n):
-                for j in range(k1):
-                    if em[i, j] >= 0:
-                        out_tokens[i].append(int(em[i, j]))
-                        out_lps[i].append(float(lps[i, j]))
-            # early exit on host-side stops (ADVICE r1): the device round
-            # only knows eos_id — a matched stop_ids/stop_sequences request
-            # would otherwise keep burning target+draft rounds to
-            # max_new_tokens before the post-hoc trim
+            for r in range(R):
+                pk = pks[r]
+                em = pk[:, :k1]
+                lps = np.ascontiguousarray(
+                    pk[:, k1: 2 * k1]).view(np.float32)
+                n_acc_np = pk[:, 2 * k1]
+                act_host = pk[:, 2 * k1 + 1].astype(bool)
+                live = int((em[:, 0] >= 0).sum())
+                if not live:
+                    continue            # chunk tail after all slots froze
+                self._total_rounds += 1
+                self._total_accepted += int(n_acc_np[em[:, 0] >= 0].sum())
+                self._total_proposed += self.k * live
+                for i in range(n):
+                    for j in range(k1):
+                        if em[i, j] >= 0:
+                            out_tokens[i].append(int(em[i, j]))
+                            out_lps[i].append(float(lps[i, j]))
+            # early exit on host-side stops (ADVICE r1), now at CHUNK
+            # granularity: the device rounds only know eos_id — a matched
+            # stop_ids/stop_sequences request can overshoot by up to R
+            # rounds (trimmed post-hoc) but no longer burns to
+            # max_new_tokens
             stopped_rows = scan_host_stops(out_tokens, requests, act_host,
                                            scanned)
             if stopped_rows and act_host.any():

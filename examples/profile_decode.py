@@ -1,0 +1,109 @@
+"""Profile the flagship decode chunk and attribute device time per op.
+
+Captures a ``jax.profiler`` trace of a few steady-state decode chunks on
+the continuous engine (same env knobs as bench.py), parses the xplane
+protobuf directly (the tensorboard converter is broken against the
+installed protobuf), and prints a device-time table grouped by op class —
+the itemization VERDICT r3 item 5 asked for.
+
+    BENCH_QUANT=1 python examples/profile_decode.py      # int8 rung
+    BENCH_QUANT=4 python examples/profile_decode.py      # int4 kernel rung
+"""
+
+import collections
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import bench  # noqa: E402
+from bench import log  # noqa: E402
+
+
+def classify(name: str) -> str:
+    n = name.lower()
+    if "int4_matmul" in n or "tpu_custom_call" in n:
+        return "int4 kernel (weights)"
+    if "dot" in n or "convolution" in n or "einsum" in n:
+        return "matmul fusions (weights/attn)"
+    if "gather" in n:
+        return "ctx gather (KV pages)"
+    if "scatter" in n or "dynamic-update" in n:
+        return "KV writeback/scatter"
+    if "fusion" in n:
+        return "other fusions (elementwise/attn)"
+    if "copy" in n or "bitcast" in n or "transpose" in n or "reshape" in n:
+        return "layout/copies"
+    if "infeed" in n or "outfeed" in n or "send" in n or "recv" in n:
+        return "host transfer"
+    return "other"
+
+
+def parse_xplane(trace_dir: str):
+    """Sum device-time (ps) per HLO op name on the TPU plane."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    per_op = collections.Counter()
+    total_ps = 0
+    for path in paths:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            if "TPU" not in plane.name or "device" not in plane.name.lower():
+                continue
+            meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+            for line in plane.lines:
+                for ev in line.events:
+                    name = meta.get(ev.metadata_id, "?")
+                    per_op[name] += ev.duration_ps
+                    total_ps += ev.duration_ps
+    return per_op, total_ps
+
+
+def main() -> None:
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    spec = bench._spec()
+    steps = int(os.environ.get("BENCH_STEPS", "16"))
+    params = bench._build_params(spec, bench.QUANT)
+    engine = bench._engine(spec, params, "continuous", bench.BATCH, steps)
+    log("engine up; warming")
+    engine.generate(bench._requests(spec, 1, bench.BATCH))   # compile+prime
+
+    # steady state: fill slots, then profile a few pure-decode chunks
+    for r in bench._requests(spec, 2, bench.BATCH):
+        engine.submit(r)
+    engine.step()                                    # admission + chunk 1
+    trace_dir = os.environ.get("PROFILE_DIR", "/tmp/decode_trace")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            engine.step()
+    engine.abort_all()
+    log(f"trace captured in {trace_dir}")
+
+    per_op, total_ps = parse_xplane(trace_dir)
+    by_class = collections.Counter()
+    for name, ps in per_op.items():
+        by_class[classify(name)] += ps
+    print(f"\ndevice time over 3 decode chunks "
+          f"({steps} steps each, bs{bench.BATCH}, "
+          f"int{'4' if bench.QUANT_BITS == 4 and bench.QUANT else '8' if bench.QUANT else 'none'}):")
+    print(f"{'class':36s} {'ms':>9s} {'share':>7s}")
+    for cls, ps in by_class.most_common():
+        print(f"{cls:36s} {ps / 1e9:9.2f} {ps / total_ps:7.1%}")
+    print(f"{'TOTAL':36s} {total_ps / 1e9:9.2f}")
+    print("\ntop 20 ops:")
+    for name, ps in per_op.most_common(20):
+        print(f"  {ps / 1e9:8.2f} ms  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

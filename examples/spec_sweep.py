@@ -1,0 +1,97 @@
+"""Speculative-decoding acceptance sweep on hardware (VERDICT r3 item 3).
+
+One 8B-class int8 target; draft = its first L_d blocks (truncated
+self-draft); per ε the target's top blocks are residual-scaled by ε
+(``scale_top_blocks``), so acceptance runs from exactly 1 (ε=0: top
+blocks are identities, draft ≡ target in logits while costing L_d/L of a
+step) down to ~0 (ε=1: r3's measured regime). Prints one JSON row per ε:
+tok/s, acceptance, tokens/round, and the ratio to the measured autoregressive
+baseline — the curve the README's acceptance-threshold claim comes from.
+
+    SPEC_EPS=0,0.125,0.25,0.5,1.0 SPEC_K=4 SPEC_DRAFT_LAYERS=8 \
+        python examples/spec_sweep.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+
+import bench  # noqa: E402
+from bench import log  # noqa: E402
+
+# measured autoregressive reference at the same rung (continuous int8
+# bs64, r4): the number a winning point must beat
+AR_BASELINE = float(os.environ.get("SPEC_BASELINE", "3628"))
+
+
+def main() -> None:
+    import jax
+
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.speculative import (
+        SpeculativeEngine,
+        scale_top_blocks,
+        truncated_draft,
+    )
+
+    log(f"devices: {jax.devices()}")
+    spec = bench._spec()
+    eps_list = [float(e) for e in os.environ.get(
+        "SPEC_EPS", "0,0.125,0.25,0.5,1.0").split(",")]
+    k = int(os.environ.get("SPEC_K", "4"))
+    rounds = int(os.environ.get("SPEC_ROUNDS", "4"))
+    n_draft = int(os.environ.get("SPEC_DRAFT_LAYERS", "8"))
+
+    t0 = time.perf_counter()
+    base = bench._build_params(spec, bench.QUANT)
+    if base is None:
+        from distributed_inference_engine_tpu.models.base import init_params
+
+        base = init_params(spec, jax.random.key(0))
+    d_spec, d_params = truncated_draft(spec, base, n_draft)
+    log(f"params + draft ({n_draft}/{spec.n_layers} layers): "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    cfg = EngineConfig(
+        max_slots=bench.BATCH,
+        max_seq_len=min(spec.max_seq_len,
+                        bench.PROMPT_LEN + bench.NEW_TOKENS + k + 1),
+        prefill_buckets=[bench.PROMPT_LEN],
+    )
+
+    for eps in eps_list:
+        tp = scale_top_blocks(spec, base, n_draft, eps)
+        eng = SpeculativeEngine(spec, d_spec, params=tp,
+                                draft_params=d_params, config=cfg,
+                                speculate_k=k, rounds_per_call=rounds)
+        t0 = time.perf_counter()
+        eng.generate(bench._requests(spec, 1, bench.BATCH))     # compile+prime
+        log(f"eps={eps}: warm in {time.perf_counter() - t0:.1f}s")
+        best = 0.0
+        for r in range(2):
+            t0 = time.perf_counter()
+            results = eng.generate(bench._requests(spec, 50 + r, bench.BATCH))
+            gen = sum(len(x.tokens) for x in results)
+            decode_s = results[0].decode_s
+            toks = (gen - len(results)) / decode_s
+            best = max(best, toks)
+            log(f"  run {r}: {gen} tokens, decode {decode_s:.2f}s "
+                f"-> {toks:.1f} tok/s")
+        m = eng.get_metrics()
+        print(json.dumps({
+            "eps": eps,
+            "toks_per_s": round(best, 1),
+            "vs_autoregressive": round(best / AR_BASELINE, 3),
+            "acceptance": round(m["draft_acceptance_rate"], 3),
+            "tokens_per_round": round(m["tokens_per_round"], 2),
+            "k": k, "rounds_per_call": rounds, "draft_layers": n_draft,
+        }), flush=True)
+        del eng, tp
+
+
+if __name__ == "__main__":
+    main()

@@ -203,3 +203,56 @@ def test_truncated_draft_quantized_tree():
     assert wq.q.shape[0] == 3 and wq.s.shape[0] == 3
     with pytest.raises(ValueError, match="draft layers"):
         truncated_draft(SPEC, qparams, SPEC.n_layers)
+
+
+def test_scale_top_blocks_eps0_matches_draft_logits():
+    """eps=0 makes every block above n_shared an exact identity on the
+    residual stream: full-model logits == truncated-draft logits, so
+    greedy acceptance is exactly 1 — the sweep's ceiling anchor."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.engine.speculative import (
+        scale_top_blocks,
+        truncated_draft,
+    )
+    from distributed_inference_engine_tpu.models.base import (
+        forward_train,
+        init_params,
+    )
+
+    import jax.numpy as jnp
+
+    params = init_params(SPEC, jax.random.key(9))
+    d_spec, d_params = truncated_draft(SPEC, params, 1)
+    tp = scale_top_blocks(SPEC, params, 1, 0.0)
+    toks = jnp.asarray([[1, 2, 3, 4, 5, 6]], jnp.int32)
+    lens = jnp.full((1,), 6, jnp.int32)
+    full = np.asarray(forward_train(SPEC, tp, toks, lens))
+    draft = np.asarray(forward_train(d_spec, d_params, toks, lens))
+    np.testing.assert_allclose(full, draft, rtol=1e-5, atol=1e-5)
+
+    # eps>0 must diverge (the construction is not degenerate)
+    tp2 = scale_top_blocks(SPEC, params, 1, 0.5)
+    full2 = np.asarray(forward_train(SPEC, tp2, toks, lens))
+    assert np.abs(full2 - draft).max() > 1e-3
+
+
+def test_scale_top_blocks_quantized_scales_only():
+    """Quantized trees scale only the per-channel scale arrays — the
+    payload is shared with the base tree (no second 8-GB copy)."""
+    from distributed_inference_engine_tpu.engine.speculative import (
+        scale_top_blocks,
+    )
+    from distributed_inference_engine_tpu.ops.quant import (
+        random_quantized_params,
+    )
+
+    base = random_quantized_params(SPEC, jax.random.key(1))
+    tp = scale_top_blocks(SPEC, base, 1, 0.25)
+    assert tp["blocks"]["wo"].q is base["blocks"]["wo"].q
+    import numpy as np
+
+    s0 = np.asarray(base["blocks"]["wo"].s)
+    s1 = np.asarray(tp["blocks"]["wo"].s)
+    np.testing.assert_allclose(s1[:1], s0[:1])
+    np.testing.assert_allclose(s1[1:], s0[1:] * 0.25)

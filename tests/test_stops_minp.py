@@ -236,9 +236,9 @@ def test_speculative_engine_exits_rounds_early_on_host_stop():
     base = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
                               temperature=0.0)])[0].tokens
     stop = base[2]
-    calls = _count_calls(eng, "_round")
+    calls = _count_calls(eng, "_rounds")
     out = eng.generate([_req(prompt=[1, 2, 3], max_new_tokens=40,
                              temperature=0.0, stop_ids=[stop])])[0]
     assert out.tokens == base[:3]
     assert out.finish_reason == "stop"
-    assert calls["n"] <= 2, f"{calls['n']} rounds ran after the stop"
+    assert calls["n"] <= 2, f"{calls['n']} round chunks ran after the stop"
