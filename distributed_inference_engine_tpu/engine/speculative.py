@@ -367,17 +367,31 @@ class SpeculativeEngine:
                     rounds: int):
             """``rounds`` speculative rounds in ONE dispatch; the host
             reads one stacked packed buffer per call. Slots that finish
-            mid-chunk stay frozen for the remaining rounds (emitted=-1),
-            bounding the wasted compute at rounds-1 masked rounds."""
+            mid-chunk stay frozen for the remaining rounds (emitted=-1);
+            once EVERY slot froze, the remaining rounds skip entirely
+            (``lax.cond`` on a scalar pred runs one branch on TPU), so an
+            overshooting chunk streams no weights — that makes the
+            one-ahead optimistic dispatch in ``generate`` nearly free."""
 
             def body(carry, kr):
-                tck, tcv, dck, dcv, lengths, last, active, produced = carry
-                (tck, tcv, dck, dcv, lengths, last, active, produced,
-                 packed) = _round_core(
-                    pt, pd, tck, tcv, dck, dcv, lengths, last, active,
-                    produced, max_new, eos_ids, sampling, kr)
-                return ((tck, tcv, dck, dcv, lengths, last, active,
-                         produced), packed)
+                def run(c):
+                    (tck, tcv, dck, dcv, lengths, last, active,
+                     produced) = c
+                    return _round_core(
+                        pt, pd, tck, tcv, dck, dcv, lengths, last, active,
+                        produced, max_new, eos_ids, sampling, kr)
+
+                def skip(c):
+                    b = c[4].shape[0]
+                    packed = jnp.concatenate(
+                        [jnp.full((b, k + 1), -1, jnp.int32),
+                         jnp.zeros((b, k + 1), jnp.int32),
+                         jnp.zeros((b, 2), jnp.int32)], axis=1)
+                    return (*c, packed)
+
+                *state, packed = jax.lax.cond(
+                    jnp.any(carry[6]), run, skip, carry)
+                return tuple(state), packed
 
             carry, packs = jax.lax.scan(
                 body, (tck, tcv, dck, dcv, lengths, last, active, produced),
@@ -502,14 +516,33 @@ class SpeculativeEngine:
             active = active.at[
                 jnp.asarray(stopped_rows, jnp.int32)].set(False)
         R = self.rounds_per_call
+        # host-side stop detection must land on device state between
+        # chunks, so such requests keep the sync dispatch→read loop;
+        # everything else runs one chunk AHEAD (dispatch i+1, then read
+        # i): the packed read — a full round trip on a tunnelled chip —
+        # overlaps the next chunk's execution, and a chunk dispatched
+        # past the end all-skips on device (``_rounds``)
+        overlap = not any(r.stop_ids or r.stop_sequences
+                          for r in requests)
+        state = (tck, tcv, dck, dcv, lengths, last, active, produced)
+        del tck, tcv, dck, dcv, active
+        pending = None
         while act_host.any():
-            self._rng, kr = jax.random.split(self._rng)
-            ((tck, tcv, dck, dcv, lengths, last, active, produced),
-             packs) = self._rounds(
-                self.params, self.draft_params, tck, tcv, dck, dcv,
-                lengths, last, active, produced,
-                max_new_j, eos_j, sampling, kr, rounds=R,
-            )
+            if pending is None:
+                self._rng, kr = jax.random.split(self._rng)
+                state, packs = self._rounds(
+                    self.params, self.draft_params, *state,
+                    max_new_j, eos_j, sampling, kr, rounds=R,
+                )
+            else:
+                state, packs = pending
+                pending = None
+            if overlap:
+                self._rng, kr = jax.random.split(self._rng)
+                pending = self._rounds(
+                    self.params, self.draft_params, *state,
+                    max_new_j, eos_j, sampling, kr, rounds=R,
+                )
             pks = np.asarray(packs)     # ONE blocking read per R rounds
             k1 = self.k + 1
             for r in range(R):
@@ -538,8 +571,11 @@ class SpeculativeEngine:
             stopped_rows = scan_host_stops(out_tokens, requests, act_host,
                                            scanned)
             if stopped_rows and act_host.any():
-                active = active.at[
-                    jnp.asarray(stopped_rows, jnp.int32)].set(False)
+                # sync path only (``overlap`` is off for such requests)
+                state = state[:6] + (
+                    state[6].at[jnp.asarray(stopped_rows,
+                                            jnp.int32)].set(False),
+                    state[7])
         decode_t = time.perf_counter() - t1
         self.round_stats.add(decode_t)
 
