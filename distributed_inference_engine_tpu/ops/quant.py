@@ -22,15 +22,17 @@ Scheme: symmetric per-output-channel int8.
 ``lax.scan`` over stacked layer blocks unchanged: the scan slices ``q`` and
 ``s`` along the layer axis together.
 
-int4 (packed nibbles, ``bits=4``) — MEASURED NEGATIVE on this compiler
-path, kept as a capability: correctness is fully tested (pack round-trip,
-fused-matmul-vs-dequantized parity, engine token parity), and the packed
-tree halves int8's storage/checkpoint bytes, but at the 8B bench rung it
-decodes at 1,584 tok/s vs int8's 3,661 (hbm_util 0.16): XLA materializes
-the unpacked operand instead of fusing the nibble shifts into the dot
-feed, so HBM sees 2-byte traffic plus the packed read. A real int4
-bandwidth win needs a Mosaic/Pallas matmul kernel with in-register
-unpack — future work; int8 is the measured sweet spot today.
+int4 (packed nibbles, ``bits=4``) — the FASTEST measured single-chip
+config since r4: 4,254 tok/s vs int8's 3,661 at the 8B bs64 rung, via
+the Mosaic in-register-unpack matmul (``ops/int4_matmul.py``), which on
+single-device TPU processes takes the layer-STACKED payload whole and
+selects the layer inside the pallas grid (``split_indexed_blocks`` +
+``IndexedQuant`` below keep those payloads out of the layer-scan xs — a
+scanned slice feeding an opaque custom call would be materialized as a
+real HBM copy, the r3→r4 1,584→3,308 cliff). The pure-XLA fallback
+(multi-device / CPU) fuses the nibble shifts into the dot operand
+(``_einsum_int4``) but XLA still materializes the unpacked operand —
+its measured 1,584 tok/s is why the kernel exists.
 """
 
 from __future__ import annotations
@@ -202,8 +204,45 @@ def _einsum_int4(pattern: str, x: jnp.ndarray,
     return y * _out_scale(w.s).astype(y.dtype)
 
 
+@dataclasses.dataclass
+class IndexedQuant:
+    """A layer-stacked ``QuantizedTensor`` + the layer index to use —
+    built inside a layer-scan body (``split_indexed_blocks``) so the
+    int4 Mosaic kernel can read its layer's blocks straight out of the
+    whole stacked payload (scalar-prefetch index_map) instead of a
+    scanned slice, which XLA would materialize as a real HBM copy
+    before the opaque custom call."""
+
+    qt: "QuantizedTensor"
+    idx: Any                    # scalar int32 (traced)
+
+
+def split_indexed_blocks(blocks: Dict[str, Any]):
+    """Split a stacked blocks tree for a layer scan: kernel-eligible
+    int4 payloads leave the scan xs (returned tree) and are re-attached
+    per-iteration as ``IndexedQuant`` by ``rebuild(xs_slice, idx)``.
+    Identity when the stacked kernel is not engaged (multi-device, CPU,
+    int8, …) — the XLA paths fuse scanned slices for free."""
+    from .int4_matmul import stacked_kernel_wants
+
+    static = {name: w for name, w in blocks.items()
+              if stacked_kernel_wants(w)}
+    if not static:
+        return blocks, (lambda xs_blk, i: xs_blk)
+    xs = {name: w for name, w in blocks.items() if name not in static}
+
+    def rebuild(xs_blk, i):
+        blk = dict(xs_blk)
+        for name, qt in static.items():
+            blk[name] = IndexedQuant(qt, i)
+        return blk
+
+    return xs, rebuild
+
+
 def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
-    """``einsum`` that accepts a plain array or a ``QuantizedTensor``.
+    """``einsum`` that accepts a plain array, a ``QuantizedTensor``, or a
+    layer-``IndexedQuant``.
 
     For a quantized weight the payload is widened to the activation dtype
     at the MXU feed and the per-output-channel scale multiplies the result
@@ -211,6 +250,14 @@ def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     int8 streams the bytes directly; packed int4 unpacks INSIDE the dot
     operand (``_einsum_int4``), so HBM sees half the int8 bytes.
     """
+    if isinstance(w, IndexedQuant):
+        from .int4_matmul import int4_einsum_kernel_stacked, pattern_fits
+
+        if pattern_fits(pattern, x, w.qt.q.shape[1]):
+            return int4_einsum_kernel_stacked(pattern, x, w.qt, w.idx)
+        # fallback: slice the layer out (materializes — correctness only)
+        s = w.qt.s[w.idx] if w.qt.s.ndim == w.qt.q.ndim else w.qt.s
+        w = dataclasses.replace(w.qt, q=w.qt.q[w.idx], s=s)
     if isinstance(w, QuantizedTensor):
         if w.bits == 4:
             from .int4_matmul import int4_einsum_kernel, kernel_wants

@@ -118,3 +118,53 @@ def test_int4_engine_tokens_unchanged_by_kernel_path(kernel_on):
     set_kernel_mode("off")
     t_xla = Engine(spec, params=params, config=cfg).generate(reqs())[0]
     assert t_kernel.tokens == t_xla.tokens
+
+
+def test_stacked_kernel_layer_indexed_matches_sliced(kernel_on):
+    """The scalar-prefetch stacked kernel (layer picked by the grid's
+    index_map, no materialized slice) must match the per-layer 2-D
+    kernel for every layer, and matmul_any must route IndexedQuant to
+    it."""
+    from distributed_inference_engine_tpu.ops.int4_matmul import (
+        int4_einsum_kernel_stacked,
+        stacked_kernel_wants,
+    )
+
+    rs = np.random.RandomState(7)
+    L, K, N = 3, 256, 384
+    w = jnp.asarray(rs.randn(L, K, N).astype("float32") * 0.05)
+    qt = quant.quantize_weight(w, (1,), bits=4)
+    assert stacked_kernel_wants(qt)
+    x = jnp.asarray(rs.randn(4, K).astype("float32"))
+    for l in range(L):
+        per_layer = quant.QuantizedTensor(q=qt.q[l], s=qt.s[l],
+                                          bits=4, pack_axis=qt.pack_axis)
+        ref = quant.matmul_any("bd,df->bf", x, per_layer)
+        got = int4_einsum_kernel_stacked("bd,df->bf", x, qt, jnp.int32(l))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        via_any = quant.matmul_any("bd,df->bf", x,
+                                   quant.IndexedQuant(qt, jnp.int32(l)))
+        np.testing.assert_array_equal(np.asarray(via_any), np.asarray(got))
+
+
+def test_split_indexed_blocks_identity_when_off():
+    """With the kernel disabled the split is an identity — the XLA paths
+    keep their scanned-slice fusion."""
+    from distributed_inference_engine_tpu.ops.quant import (
+        split_indexed_blocks,
+    )
+
+    set_kernel_mode("off")
+    try:
+        rs = np.random.RandomState(3)
+        w = jnp.asarray(rs.randn(2, 64, 64).astype("float32"))
+        blocks = {"wq": quant.quantize_weight(w, (1,), bits=4),
+                  "ln1_scale": jnp.ones((2, 64))}
+        xs, rebuild = split_indexed_blocks(blocks)
+        assert set(xs) == {"wq", "ln1_scale"}
+        blk = rebuild({k: jax.tree.map(lambda a: a[0], v)
+                       for k, v in xs.items()}, 0)
+        assert not isinstance(blk["wq"], quant.IndexedQuant)
+    finally:
+        set_kernel_mode("auto")
