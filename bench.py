@@ -5,8 +5,9 @@ Prints exactly ONE JSON line on stdout:
 Diagnostics go to stderr.
 
 Default rung (BASELINE.md ladder rung 3-4, VERDICT r1 item 1): steady-state
-decode throughput of an **8B-class Llama-shaped model, int8 weight-only,
-continuous engine with paged KV** on one v5e chip — random-init (weights'
+decode throughput of an **8B-class Llama-shaped model, packed-int4 weights
+(the fastest measured config — stacked Mosaic kernel, r4), continuous
+engine with paged KV** on one v5e chip — random-init (weights'
 values don't change the FLOP/byte counts; zero-egress environment has no
 checkpoint on disk). Alongside tok/s it reports the HBM roofline:
 ``hbm_util`` = achieved bytes/s ÷ the chip's ~819 GB/s — decode is
@@ -292,7 +293,8 @@ def decode_main() -> None:
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
     engine = _engine(spec, params, ENGINE_KIND, BATCH, steps)
-    log(f"engine init ({MODEL}, {ENGINE_KIND}, int8={QUANT}): "
+    log(f"engine init ({MODEL}, {ENGINE_KIND}, "
+        f"quant={QUANT_BITS if QUANT else 0}): "
         f"{time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
@@ -384,7 +386,7 @@ def serving_main() -> None:
         os.environ.get("BENCH_MAX_WAITING", str(4 * BATCH)))
     engine.config.queue_deadline_s = float(
         os.environ.get("BENCH_DEADLINE_S", "10"))
-    log(f"engine init ({MODEL}, serving, int8={QUANT}): "
+    log(f"engine init ({MODEL}, serving, quant={QUANT_BITS if QUANT else 0}): "
         f"{time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()
     # Poisson arrivals admit in small bursts: EVERY pow2 admission bucket

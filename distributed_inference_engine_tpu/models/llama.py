@@ -47,6 +47,10 @@ def llama_spec(size: str = "llama3-8b", **overrides) -> ModelSpec:
 # name: (layers, d_model, heads, kv_heads, d_ff, vocab, theta, max_seq, E, k)
 _MOE_FAMILY = {
     "mixtral-8x7b": (32, 4096, 32, 8, 14336, 32000, 1e6, 32768, 8, 2),
+    # ~0.9B-param 8-expert rung that fits one 16 GB chip comfortably —
+    # the single-chip MoE measurement config (README; BENCH_MODEL=
+    # mixtral-small)
+    "mixtral-small": (8, 1024, 16, 8, 3584, 32000, 1e6, 4096, 8, 2),
     "mixtral-tiny": (4, 256, 8, 4, 256, 1024, 10000.0, 512, 4, 2),
 }
 

@@ -102,7 +102,8 @@ def main():
         os.environ.get("BENCH_MAX_WAITING", str(bench.BATCH)))
     engine.config.queue_deadline_s = float(
         os.environ.get("BENCH_DEADLINE_S", "8"))
-    log(f"engine init ({bench.MODEL}, bs{bench.BATCH}, int8={bench.QUANT}, "
+    log(f"engine init ({bench.MODEL}, bs{bench.BATCH}, "
+        f"quant={bench.QUANT_BITS if bench.QUANT else 0}, "
         f"max_waiting={engine.config.max_waiting}, "
         f"deadline={engine.config.queue_deadline_s}s): "
         f"{time.perf_counter() - t0:.1f}s")
