@@ -166,7 +166,11 @@ class ContinuousEngine:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
             params = shard_fn(params)
-        self.params = params
+        from ..ops.quant import prepare_params
+
+        # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
+        # payload fusion, shared across engines (ops.quant.prepare_params)
+        self.params = prepare_params(params)
         self._rng = jax.random.key(seed + 1)
 
         self.max_slots = cfg.max_slots
@@ -1290,11 +1294,10 @@ class ContinuousEngine:
             return len(self._slots) + len(self._prefilling)
 
         t0 = time.perf_counter()
-        cap = jnp.asarray(
-            [min(self.kv.slot_capacity(s), self.max_seq_len)
-             if s in self._slots else 0
-             for s in range(self.max_slots)], jnp.int32,
-        )
+        cap_list = [min(self.kv.slot_capacity(s), self.max_seq_len)
+                    if s in self._slots else 0
+                    for s in range(self.max_slots)]
+        cap = jnp.asarray(cap_list, jnp.int32)
         mpb = 0
         if self._use_dense_ctx:
             # dense working buffer covers the longest LIVE prefix, padded
@@ -1326,19 +1329,23 @@ class ContinuousEngine:
         snapshot = dict(self._slots)
         if self._defer:
             prev, self._pending = self._pending, (packed, n_steps,
-                                                  snapshot, t0)
+                                                  snapshot, t0, cap_list)
             if prev is not None:
                 self._process_packed(*prev)
         else:
-            self._process_packed(packed, n_steps, snapshot, t0)
+            self._process_packed(packed, n_steps, snapshot, t0, cap_list)
         return len(self._slots) + len(self._prefilling)
 
     def _process_packed(self, packed, n_steps: int,
-                        snapshot: Dict[int, _Slot], t0: float) -> None:
+                        snapshot: Dict[int, _Slot], t0: float,
+                        caps: Optional[List[int]] = None) -> None:
         """Host bookkeeping of one decode chunk's packed output: append
         tokens, update the length mirror, detect host-side stops, stream,
         finish retired slots. ``snapshot`` is the slot map at dispatch —
-        entries whose ``_Slot`` is no longer current are skipped."""
+        entries whose ``_Slot`` is no longer current are skipped.
+        ``caps`` is the per-slot token-capacity array the chunk was
+        dispatched with — needed to tell a PAUSED slot (device stopped at
+        the chunk's capacity grant) from a finished one."""
         t_read = time.perf_counter()
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
@@ -1355,12 +1362,22 @@ class ContinuousEngine:
                              - (t_read if self._defer else t0))
 
         stop_retired: List[int] = []
+        revived: List[int] = []
         for slot, state in snapshot.items():
             if self._slots.get(slot) is not state:
                 continue                 # finished earlier (or slot reused)
             self._lengths_host[slot] = lengths_row[slot]
             col = toks_np[:, slot]
             lcol = lps_np[:, slot]
+            # no progress == the slot was device-INACTIVE when this chunk
+            # was dispatched (an active slot always emits >=1 token per
+            # chunk: the capacity loop guarantees cap > length at
+            # dispatch). Happens under defer_sync when a capacity-paused
+            # slot's revive lands after the next chunk already launched —
+            # that chunk's harvest must not re-judge the slot (its caps
+            # row is from AFTER the pool grew, so the pause test would
+            # misread the pause as a finished "length").
+            progressed = bool(state.first_pending or (col >= 0).any())
             prev = len(state.tokens)           # first index not yet stop-checked
             if state.first_pending:
                 # harvest the deferred first token (prev stays 0: the stop
@@ -1385,9 +1402,28 @@ class ContinuousEngine:
                 state.stop_cut = find_stop_cut(state.tokens, req, start=prev)
             self._emit_stream(state)
             if not active_np[slot]:
-                # _finish re-trims and upgrades the reason to "stop" when a
-                # stop condition is inside the cap
-                self._finish(slot, "length")
+                if not progressed:
+                    # inactive for the WHOLE chunk: pause/finish was (or
+                    # will be) decided by the chunk that actually stopped
+                    # it; nothing to judge here
+                    pass
+                elif (caps is not None
+                        and state.produced < req.max_new_tokens
+                        and state.stop_cut < 0
+                        and int(lengths_row[slot]) >= caps[slot]):
+                    # the device stopped at the chunk's CAPACITY grant
+                    # (ensure_capacity landed exactly on a page boundary,
+                    # e.g. prompt+chunk = one page), not at a budget or
+                    # stop condition: the slot is paused, not finished.
+                    # Revive it — next step's capacity loop grows its
+                    # pages (or retires it for real if the pool is dry).
+                    # Without this, a request whose prompt+chunk filled
+                    # page 1 finished early as "length" with budget left.
+                    revived.append(slot)
+                else:
+                    # _finish re-trims and upgrades the reason to "stop"
+                    # when a stop condition is inside the cap
+                    self._finish(slot, "length")
             elif ((req.stop_ids or req.stop_sequences)
                   and 0 <= state.stop_cut <= req.max_new_tokens):
                 # host-side stops (multi-id / multi-token): the device loop
@@ -1395,6 +1431,9 @@ class ContinuousEngine:
                 stop_retired.append(slot)
                 self._finish(slot, "stop")
         self._deactivate_many(stop_retired)
+        if revived:
+            self._active = self._active.at[
+                jnp.asarray(revived, jnp.int32)].set(True)
 
     def _deactivate_many(self, slots: List[int]) -> None:
         """Clear retired slots' device active flags in ONE dispatch — a

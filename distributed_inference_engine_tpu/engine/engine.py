@@ -113,7 +113,11 @@ class Engine:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
             params = shard_fn(params)
-        self.params = params
+        from ..ops.quant import prepare_params
+
+        # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
+        # payload fusion, shared across engines (ops.quant.prepare_params)
+        self.params = prepare_params(params)
         self._rng = jax.random.key(seed + 1)
 
         # context-parallel decode: with an sp mesh the dense KV cache is

@@ -210,8 +210,14 @@ def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
 
         return moe_mlp(spec, blk, x, exact=exact_moe)
     if spec.mlp in ("swiglu", "geglu"):
-        gate = matmul_any("btd,df->btf", x, blk["w_gate"])
-        up = matmul_any("btd,df->btf", x, blk["w_up"])
+        if "w_gate_up" in blk:
+            # fused gate+up (ops.quant.fuse_block_weights): one weight
+            # stream of N=2F per layer instead of two F launches
+            gu = matmul_any("btd,df->btf", x, blk["w_gate_up"])
+            gate, up = jnp.split(gu, 2, axis=-1)
+        else:
+            gate = matmul_any("btd,df->btf", x, blk["w_gate"])
+            up = matmul_any("btd,df->btf", x, blk["w_up"])
         act = (jax.nn.silu if spec.mlp == "swiglu"
                else partial(jax.nn.gelu, approximate=True))   # geglu: Gemma
         h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
@@ -229,11 +235,18 @@ def _mlp(spec: ModelSpec, blk: Params, x, exact_moe: bool = True):
 def _qkv(spec: ModelSpec, blk: Params, x, positions):
     b, t, _ = x.shape
     H, Hkv, Dh = spec.n_heads, spec.n_kv_heads, spec.head_dim
-    q = matmul_any("btd,de->bte", x, blk["wq"])
-    k = matmul_any("btd,de->bte", x, blk["wk"])
-    v = matmul_any("btd,de->bte", x, blk["wv"])
-    if spec.use_bias or spec.qkv_bias:
-        q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
+    if "w_qkv" in blk:
+        # fused q|k|v (ops.quant.fuse_block_weights): the small-N k/v
+        # projections ride one N = (H+2Hkv)·Dh launch — fusion is skipped
+        # at build time when qkv biases exist, so no bias branch here
+        qkv = matmul_any("btd,de->bte", x, blk["w_qkv"])
+        q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+    else:
+        q = matmul_any("btd,de->bte", x, blk["wq"])
+        k = matmul_any("btd,de->bte", x, blk["wk"])
+        v = matmul_any("btd,de->bte", x, blk["wv"])
+        if spec.use_bias or spec.qkv_bias:
+            q, k, v = q + blk["bq"], k + blk["bk"], v + blk["bv"]
     q = q.reshape(b, t, H, Dh)
     k = k.reshape(b, t, Hkv, Dh)
     v = v.reshape(b, t, Hkv, Dh)

@@ -1,0 +1,12 @@
+#!/bin/sh
+# Build every native component in this directory from source.
+# (Runtime equivalent: native.load_library() rebuilds a stale/missing .so
+# automatically on first use — this script exists for explicit/offline
+# builds and CI. The .so artifacts are NOT committed; see .gitignore.)
+set -e
+cd "$(dirname "$0")"
+for src in *.cpp; do
+    out="_${src%.cpp}.so"
+    echo "g++ -O2 -std=c++17 -shared -fPIC $src -o $out"
+    g++ -O2 -std=c++17 -shared -fPIC "$src" -o "$out"
+done

@@ -34,6 +34,19 @@ the layer index as a scalar-prefetch argument; the grid's index_maps pick
 block ``(layer, k, j)`` straight from the stacked array in HBM. Measured:
 1,584 (XLA) → 3,308 (sliced kernel) → 4,254 tok/s (stacked kernel) vs
 int8's 3,661 at the 8B bs64 rung.
+
+r5 added (a) per-shape tuned blocks + engine-init payload fusion
+(``ops.quant.fuse_block_weights``): 4,254 → 4,639 at bs64, and the
+flagship moved to bs128 (5,315 tok/s — int4's freed HBM fits bs128 with
+bf16 KV); and (b) tensor-parallel composition (mode "cp"): the kernel
+rides a ``custom_partitioning`` op whose Shardy rule passes x pre-split
+as (xlo, xhi) so both halves' K/2 axis and the payload's packed axis
+share one reduction factor — the split-half layout then shards
+COHERENTLY for row-parallel weights (each device's packed rows hold the
+lo nibbles of exactly its xlo shard's columns and the hi nibbles of its
+xhi shard's) and trivially for column-parallel, with no repacking and
+no gather. Engines flip to "cp" automatically when int4 params land
+sharded (``ops.quant.select_kernel_mode_for_params``).
 """
 
 from __future__ import annotations
@@ -50,20 +63,26 @@ from jax.experimental.pallas import tpu as pltpu
 # kernel dispatch mode (read at TRACE time):
 #   auto      — use the kernel on a single-device TPU process (the bench /
 #               single-chip serving deploys); XLA einsum path elsewhere.
-#               Multi-device processes keep the XLA path because a
-#               pallas_call is an opaque unit to GSPMD — tp-sharded int4
-#               weights would force a gather.
-#   on        — always (interpreted off-TPU: CPU tests of the kernel math)
+#   cp        — multi-device (tp) path: the kernel rides a
+#               ``custom_partitioning`` op with a Shardy rule, so GSPMD
+#               partitions the opaque pallas_call instead of gathering
+#               around it (r5; engines select this automatically when
+#               their int4 params land sharded across devices).
+#   on        — always, direct (interpreted off-TPU: CPU kernel tests)
 #   off       — never
 _MODE = os.environ.get("INT4_MATMUL_KERNEL", "auto")
 
 
 def set_kernel_mode(mode: str) -> None:
-    """"auto" | "on" | "off" — see module docstring."""
+    """"auto" | "cp" | "on" | "off" — see module docstring."""
     global _MODE
-    if mode not in ("auto", "on", "off"):
+    if mode not in ("auto", "cp", "on", "off"):
         raise ValueError(f"bad int4 kernel mode {mode!r}")
     _MODE = mode
+
+
+def kernel_mode() -> str:
+    return _MODE
 
 
 def _block_of(size: int, candidates: Tuple[int, ...]) -> Optional[int]:
@@ -75,14 +94,15 @@ def _block_of(size: int, candidates: Tuple[int, ...]) -> Optional[int]:
 
 def _mode_engaged() -> bool:
     """Mode/backend half of kernel eligibility (shared by the per-layer
-    and stacked predicates): "on" always, "auto" only on a single-device
-    TPU process — a pallas_call is opaque to GSPMD, so multi-device
-    processes keep the XLA path (tp-sharded weights would force a
-    gather)."""
+    and stacked predicates): "on"/"cp" always, "auto" only on a
+    single-device TPU process. ("cp" wraps the kernel in a
+    custom_partitioning op so GSPMD can partition it — without that a
+    pallas_call is opaque and tp-sharded weights would force a gather;
+    engines flip to "cp" when their int4 params land multi-device.)"""
     if _MODE == "off":
         return False
-    return _MODE == "on" or (jax.default_backend() == "tpu"
-                             and len(jax.devices()) == 1)
+    return _MODE in ("on", "cp") or (jax.default_backend() == "tpu"
+                                     and len(jax.devices()) == 1)
 
 
 def pattern_fits(pattern: str, x, k2: int) -> bool:
@@ -123,6 +143,25 @@ def kernel_wants(pattern: str, x, w) -> bool:
 _K_BLOCKS = (1024, 512, 256, 128)
 _N_BLOCKS = (2048, 1024, 512, 256, 128)
 
+# measured per-shape winners, (K/2, N) -> (bk, bn): the r5 tuning sweep
+# (examples/int4_kernel_tune.py, v5e, M=64 decode tile, median of 5
+# device-side timed passes) found no single block pair wins every shape —
+# the 8B fused gate+up stream runs 601 GB/s at bk2048/bn1024 vs ~495 at
+# the table default, and the fused-qkv shape actively pathologies at
+# bn=2048 (168-336 GB/s vs 461 at bk1024/bn1024). Shapes not listed fall
+# back to the preference tables above.
+_TUNED_BLOCKS = {
+    (2048, 6144): (1024, 1024),     # qkv fused     461 GB/s
+    (2048, 4096): (512, 4096),      # wo / wq       449 GB/s
+    (2048, 28672): (2048, 1024),    # gate+up fused 601 GB/s
+    (7168, 4096): (512, 4096),      # w_down        532 GB/s
+}
+
+
+def _blocks_for(k2: int, n: int) -> Tuple[Optional[int], Optional[int]]:
+    bk, bn = _TUNED_BLOCKS.get((k2, n), (None, None))
+    return (bk or _block_of(k2, _K_BLOCKS), bn or _block_of(n, _N_BLOCKS))
+
 
 def _int4_matmul_2d(x, packed, scale, *, interpret: bool = False):
     """``[M, K] @ unpack([K/2, N]) * scale -> [M, N]`` (dtype of x) —
@@ -135,12 +174,22 @@ def _int4_matmul_2d(x, packed, scale, *, interpret: bool = False):
 
 def int4_einsum_kernel(pattern: str, x, w):
     """``matmul_any``'s kernel path: flatten x's batch dims to M, run the
-    2-D kernel, restore. ``kernel_wants(pattern, x, w)`` must hold."""
+    2-D kernel, restore. ``kernel_wants(pattern, x, w)`` must hold.
+    Mode "cp" routes through the GSPMD-partitionable wrapper — a
+    quantized lm_head is tp-sharded on vocab (``parallel/sharding.py``),
+    and feeding the sharded payload to the direct (opaque) pallas call
+    would force GSPMD to gather it every step."""
     k2, n = w.q.shape
     lead = x.shape[:-1]
     xm = x.reshape(-1, x.shape[-1])
-    y = _int4_matmul_2d(xm, w.q, w.s.astype(jnp.float32),
-                        interpret=jax.default_backend() != "tpu")
+    interpret = jax.default_backend() != "tpu"
+    if _MODE == "cp":
+        y = _cp_stacked(interpret)(xm[:, :k2], xm[:, k2:], w.q[None],
+                                   w.s.astype(jnp.float32).reshape(1, 1, n),
+                                   jnp.zeros((1,), jnp.int32))
+    else:
+        y = _int4_matmul_2d(xm, w.q, w.s.astype(jnp.float32),
+                            interpret=interpret)
     return y.reshape(lead + (n,))
 
 
@@ -189,19 +238,31 @@ def _kernel_stacked(l_ref, xlo_ref, xhi_ref, p_ref, s_ref, o_ref, acc_ref):
         o_ref[...] = (acc_ref[...] * s_ref[0]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _int4_matmul_stacked(x, packed, scale, layer, *, interpret: bool = False):
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "bk", "bn"))
+def _int4_matmul_stacked(x, packed, scale, layer, *, interpret: bool = False,
+                         bk: Optional[int] = None, bn: Optional[int] = None):
     """``[M, K] @ unpack(packed[layer]) * scale[layer] -> [M, N]``;
     ``packed [L, K/2, N]`` stays whole in HBM — the grid's index_map
-    selects the layer via scalar prefetch, so no slice is materialized."""
+    selects the layer via scalar prefetch, so no slice is materialized.
+
+    ``bk``/``bn`` override the block-size preference tables — the tuning
+    surface ``examples/int4_kernel_tune.py`` sweeps on hardware; defaults
+    are the measured winners."""
     m, kdim = x.shape
     nl, k2, n = packed.shape
     if kdim != 2 * k2:
         raise ValueError(f"x K={kdim} vs packed K/2={k2}")
-    bk = _block_of(k2, _K_BLOCKS)
-    bn = _block_of(n, _N_BLOCKS)
+    tbk, tbn = _blocks_for(k2, n)
+    bk = bk or tbk
+    bn = bn or tbn
     if bk is None or bn is None:
         raise ValueError(f"untileable shapes K/2={k2} N={n}")
+    if k2 % bk or n % bn:
+        # explicit overrides must divide: a flooring grid would silently
+        # drop trailing K rows / leave output columns unwritten
+        raise ValueError(f"blocks bk={bk} bn={bn} do not divide "
+                         f"K/2={k2} N={n}")
     # activations tile at (16, 128) for bf16 — pad M up, slice back after.
     # bm tops out at 128 to keep the f32 accumulator block ≤1 MB alongside
     # the 2 MB double-buffered weight blocks
@@ -251,10 +312,118 @@ def int4_einsum_kernel_stacked(pattern: str, x, w, layer):
     """Stacked-kernel path for a layer-indexed weight (``IndexedQuant``):
     flatten x's batch dims to M, run the scalar-prefetch kernel against
     the WHOLE stacked payload, restore. Pattern must satisfy
-    ``kernel_wants`` on the per-layer 2-D slice shape."""
+    ``kernel_wants`` on the per-layer 2-D slice shape. Mode "cp" routes
+    through the GSPMD-partitionable wrapper instead of the direct call."""
     _l, k2, n = w.q.shape
     lead = x.shape[:-1]
     xm = x.reshape(-1, x.shape[-1])
-    y = _int4_matmul_stacked(xm, w.q, w.s.astype(jnp.float32), layer,
-                             interpret=jax.default_backend() != "tpu")
+    interpret = jax.default_backend() != "tpu"
+    if _MODE == "cp":
+        y = _cp_stacked(interpret)(xm[:, :k2], xm[:, k2:], w.q,
+                                   w.s.astype(jnp.float32),
+                                   jnp.atleast_1d(layer).astype(jnp.int32))
+    else:
+        y = _int4_matmul_stacked(xm, w.q, w.s.astype(jnp.float32), layer,
+                                 interpret=interpret)
     return y.reshape(lead + (n,))
+
+
+# ------------------------------------------ tp composition (mode "cp", r5)
+#
+# Under tensor parallelism the stacked payload arrives sharded: column-
+# parallel weights (wq/wk/wv/w_gate/w_up) on N — P(None, None, tp) — and
+# row-parallel ones (wo/w_down) on the packed contraction axis —
+# P(None, tp, None). A plain pallas_call is an opaque unit, so GSPMD
+# would all-gather the weight (the exact 1,584 tok/s loss the kernel
+# exists to avoid). The fix is a ``custom_partitioning`` wrapper with a
+# Shardy rule: x is passed PRE-SPLIT as (xlo, xhi) so both halves' K/2
+# axis and the payload's packed axis share one factor "j" — the
+# split-half layout then shards COHERENTLY (device d's packed rows hold
+# the lo nibbles of source rows [d·K2/t, (d+1)·K2/t) and the hi nibbles
+# of [K/2 + d·K2/t, ...), which is exactly device d's shard of xlo and
+# xhi) — no repacking, no gather:
+#
+#   column (n sharded): local kernel on [L, K/2, N/t], out n-sharded;
+#   row (j sharded):    local kernel on [L, K2/t, N] + psum over tp
+#                       ("j" is declared a reduction factor).
+#
+# Local-shape tiling is re-checked inside the partition callback: a
+# shard whose K2/N no longer divides the block candidates falls back to
+# the XLA dequant einsum LOCALLY (correct, slower) rather than failing
+# to lower.
+
+
+def _cp_local_fallback(xlo, xhi, packed, scale):
+    """Local-shard XLA path: nibble-unpack fused into two dots."""
+    p = packed.astype(jnp.int32)
+    lo = jax.lax.shift_right_arithmetic(jax.lax.shift_left(p, 28), 28)
+    hi = jax.lax.shift_right_arithmetic(p, 4)
+    dt = xlo.dtype
+    y = (jnp.einsum("mk,kn->mn", xlo, lo.astype(dt))
+         + jnp.einsum("mk,kn->mn", xhi, hi.astype(dt)))
+    return (y.astype(jnp.float32) * scale.reshape(1, -1)).astype(xlo.dtype)
+
+
+@functools.lru_cache(maxsize=2)
+def _cp_stacked(interpret: bool):
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _impl(xlo, xhi, packed, scale, layer):
+        xx = jnp.concatenate([xlo, xhi], axis=-1)
+        return _int4_matmul_stacked(xx, packed, scale, layer[0],
+                                    interpret=interpret)
+
+    cp = custom_partitioning(_impl)
+
+    def _partition(mesh, arg_infos, result_infos):
+        xs = arg_infos[0].sharding.spec if arg_infos[0].sharding else P()
+        ps = (arg_infos[2].sharding.spec if arg_infos[2].sharding
+              else P(None, None, None))
+        m_ax = xs[0] if len(xs) > 0 else None
+        j_ax = ps[1] if len(ps) > 1 else None
+        n_ax = ps[2] if len(ps) > 2 else None
+        arg_shardings = (NamedSharding(mesh, P(m_ax, j_ax)),
+                         NamedSharding(mesh, P(m_ax, j_ax)),
+                         NamedSharding(mesh, P(None, j_ax, n_ax)),
+                         NamedSharding(mesh, P(None, None, n_ax)),
+                         NamedSharding(mesh, P()))
+        out_sharding = NamedSharding(mesh, P(m_ax, n_ax))
+
+        def _axis_size(ax):
+            if ax is None:
+                return 1
+            names = (ax,) if isinstance(ax, str) else ax
+            size = 1
+            for nm in names:
+                size *= mesh.shape[nm]
+            return size
+
+        def lower_fn(xlo, xhi, packed, scale, layer):
+            _nl, k2l, nloc = packed.shape
+            if _block_of(k2l, _K_BLOCKS) and _block_of(nloc, _N_BLOCKS):
+                y = _impl(xlo, xhi, packed, scale, layer)
+            else:                       # untileable local shard
+                sl = jax.lax.dynamic_index_in_dim(scale, layer[0], 0,
+                                                  keepdims=False)
+                y = _cp_local_fallback(
+                    xlo, xhi,
+                    jax.lax.dynamic_index_in_dim(packed, layer[0], 0,
+                                                 keepdims=False), sl)
+            if _axis_size(j_ax) > 1:
+                y = jax.lax.psum(y, j_ax)
+            return y
+
+        return mesh, lower_fn, out_sharding, arg_shardings
+
+    rule = SdyShardingRule(
+        operand_mappings=(("m", "j"), ("m", "j"), ("l", "j", "n"),
+                          ("l", "z", "n"), ("o",)),
+        result_mappings=(("m", "n"),),
+        reduction_factors=("j",),
+    )
+    cp.def_partition(partition=_partition, sharding_rule=rule)
+    return cp

@@ -240,6 +240,114 @@ def split_indexed_blocks(blocks: Dict[str, Any]):
     return xs, rebuild
 
 
+# Fusable same-input matmul groups (r5, decode_profile.md levers): the
+# members share the activation operand and contract the same axis, so
+# their payloads concatenate along the OUTPUT axis into one stacked
+# [L, K/2, sum(N)] tensor — one kernel launch per layer instead of 2-3,
+# and the attention projections escape the small-N regime the int8
+# profile measured at ~48% of HBM peak (qkv at N∈{1024,4096} vs the
+# fused N=6144). Consumers (models.base._qkv/_mlp) slice the output —
+# contiguous activation slices, free next to the weight stream.
+FUSED_GROUPS: Dict[str, Tuple[str, ...]] = {
+    "w_qkv": ("wq", "wk", "wv"),
+    "w_gate_up": ("w_gate", "w_up"),
+}
+# biases that would have to be carried per-member (fusion is skipped when
+# any is present — of the shipped families only qwen2 sets qkv_bias, and
+# its win case is covered by the unfused path)
+_FUSE_BLOCKERS = {"w_qkv": ("bq", "bk", "bv"), "w_gate_up": ("b_up",)}
+
+
+def select_kernel_mode_for_params(params: Dict[str, Any]) -> None:
+    """Flip the int4 kernel to its GSPMD-partitionable "cp" mode when any
+    int4 payload in ``params`` has landed SHARDED across devices (tp
+    serving) — the direct pallas path is opaque to GSPMD and would force
+    a weight gather. Fully-replicated multi-device placements (dp-only
+    meshes, a speculative draft replicated next to a sharded target) do
+    NOT flip: the direct kernel + fusion path is both valid and faster
+    there. Only upgrades from "auto"; explicit "on"/"off"/"cp" settings
+    are respected. Called by the engines after param placement."""
+    from .int4_matmul import kernel_mode, set_kernel_mode
+
+    if kernel_mode() != "auto":
+        return
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if (isinstance(leaf, QuantizedTensor) and leaf.bits == 4
+                and getattr(leaf.q, "sharding", None) is not None
+                and len(leaf.q.sharding.device_set) > 1
+                and not leaf.q.sharding.is_fully_replicated):
+            set_kernel_mode("cp")
+            return
+
+
+def prepare_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Engine-init param preparation, one entry point for every engine:
+    (1) flip the int4 kernel to "cp" if placement left int4 payloads
+    sharded across devices; (2) fuse qkv / gate+up payloads when the
+    kernel is engaged — skipped per-member for tp-sharded payloads (the
+    fused output axis would shard across head groups), kept for
+    replicated trees."""
+    select_kernel_mode_for_params(params)
+    return fuse_block_weights(params)
+
+
+def fuse_block_weights(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate kernel-eligible stacked int4 payloads of each
+    ``FUSED_GROUPS`` group along the output axis — a ONE-TIME device
+    copy at engine init (never inside a traced forward: params are jit
+    arguments, so a trace-time concat would re-copy ~1 GB every call).
+
+    The fused entry is an ordinary stacked ``QuantizedTensor``: every
+    consumer path (Mosaic kernel, XLA int4 einsum on CPU/multi-device,
+    checkpoint round-trip, ``truncated_draft`` layer slicing) handles it
+    unchanged. Identity when a group's members are absent, not int4
+    stacked payloads, shape-mismatched, or bias-carrying. NOT applied
+    for TP-SHARDED payloads: the concatenated output axis would shard
+    across component boundaries (q/k/v head groups) — the check is
+    per-member sharding, not the global kernel mode, so a REPLICATED
+    tree (a speculative draft living next to a tp-sharded target that
+    flipped the mode to "cp") still fuses."""
+    from .int4_matmul import stacked_kernel_wants
+
+    def _tp_sharded(w) -> bool:
+        s = getattr(w.q, "sharding", None)
+        return (s is not None and len(s.device_set) > 1
+                and not s.is_fully_replicated)
+
+    blocks = dict(params["blocks"])
+    changed = False
+    for fused_name, members in FUSED_GROUPS.items():
+        if fused_name in blocks:
+            continue                          # already fused (idempotent)
+        ws = [blocks.get(m) for m in members]
+        if not all(isinstance(w, QuantizedTensor) and w.bits == 4
+                   and stacked_kernel_wants(w) for w in ws):
+            continue
+        if any(b in blocks for b in _FUSE_BLOCKERS[fused_name]):
+            continue
+        if any(_tp_sharded(w) for w in ws):
+            continue
+        if len({(w.q.shape[0], w.q.shape[1], w.pack_axis % w.q.ndim)
+                for w in ws}) != 1:
+            continue                          # [L, K/2] or pack axis differ
+        fused = QuantizedTensor(
+            q=jnp.concatenate([w.q for w in ws], axis=-1),
+            s=jnp.concatenate([w.s for w in ws], axis=-1),
+            bits=4, pack_axis=ws[0].pack_axis)
+        if not stacked_kernel_wants(fused):
+            continue                          # summed N must still tile
+        for m in members:
+            del blocks[m]
+        blocks[fused_name] = fused
+        changed = True
+    if not changed:
+        return params
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
 def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """``einsum`` that accepts a plain array, a ``QuantizedTensor``, or a
     layer-``IndexedQuant``.
@@ -255,8 +363,15 @@ def matmul_any(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
 
         if pattern_fits(pattern, x, w.qt.q.shape[1]):
             return int4_einsum_kernel_stacked(pattern, x, w.qt, w.idx)
-        # fallback: slice the layer out (materializes — correctness only)
-        s = w.qt.s[w.idx] if w.qt.s.ndim == w.qt.q.ndim else w.qt.s
+        # fallback: slice the layer out (materializes — correctness only).
+        # The scale must carry the stacked layer axis (keepdims — every
+        # producer in ops.quant does); a rank mismatch here would silently
+        # apply all L layers' scales to one layer's output (ADVICE r4)
+        if w.qt.s.ndim != w.qt.q.ndim:
+            raise ValueError(
+                f"stacked scale rank {w.qt.s.ndim} != payload rank "
+                f"{w.qt.q.ndim}: scale must keep the layer axis")
+        s = w.qt.s[w.idx]
         w = dataclasses.replace(w.qt, q=w.qt.q[w.idx], s=s)
     if isinstance(w, QuantizedTensor):
         if w.bits == 4:

@@ -342,3 +342,44 @@ def test_deferred_admission_eos_first_token_stops_clean():
     got, ref = run(True), run(False)
     assert got.finish_reason == ref.finish_reason == "stop"
     assert got.tokens == ref.tokens
+
+
+def test_page_boundary_pause_revives_not_finishes():
+    """A slot whose prompt + first chunk lands EXACTLY on a page boundary
+    must pause and continue, not finish early (r5 verify catch): with
+    page_size=16, chunk=4, a 12-token prompt had ensure_capacity grant
+    exactly one page (12+4=16), the device stopped at the cap, and the
+    harvest misread the pause as finish_reason="length" at 5/8 tokens."""
+    rs = np.random.RandomState(3)
+    # prompt 12 + chunk 4 == page_size 16: the historical failure shape
+    req = [GenerationRequest(
+        prompt=rs.randint(1, SPEC.vocab_size, size=12).tolist(),
+        max_new_tokens=8, temperature=0.0, request_id="edge")]
+    static = Engine(SPEC, config=_cfg(), seed=0)
+    out_s = static.generate([GenerationRequest(
+        prompt=list(req[0].prompt), max_new_tokens=8, temperature=0.0,
+        request_id="edge")])
+    cont = ContinuousEngine(SPEC, params=static.params, config=_cfg(),
+                            seed=0)
+    out_c = cont.generate(req)
+    assert len(out_c[0].tokens) == 8, out_c[0].tokens
+    assert out_c[0].tokens == out_s[0].tokens
+    assert cont.get_metrics()["capacity_finishes"] == 0
+
+
+def test_page_boundary_pause_revives_under_defer_sync():
+    """Pause + revive through the deferred-readback path. Shape chosen so
+    ensure_capacity's grant lands EXACTLY on a page boundary mid-flight
+    (prompt 8, chunk 4, ahead 2x4: 8+8=16=page): the device pauses at
+    the cap while the NEXT chunk is already dispatched with the slot
+    inactive — that chunk's harvest sees a grown caps row and must not
+    re-judge the paused slot as finished (the no-progress skip)."""
+    rs = np.random.RandomState(3)
+    req = [GenerationRequest(
+        prompt=rs.randint(1, SPEC.vocab_size, size=8).tolist(),
+        max_new_tokens=16, temperature=0.0, request_id="edge")]
+    # defer_sync needs a fully backed pool: 4 slots * 8 pages
+    cfg = _cfg(defer_sync=True, num_pages=32, max_seq_len=128)
+    cont = ContinuousEngine(SPEC, config=cfg, seed=0)
+    out = cont.generate(req)
+    assert len(out[0].tokens) == 16, out[0].tokens

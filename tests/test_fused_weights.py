@@ -1,0 +1,101 @@
+"""Fused qkv / gate+up stacked-int4 payloads (ops.quant.fuse_block_weights,
+r5) — the decode-profile lever "one kernel launch for gate+up" plus the
+small-N attention projections (int8 profile: qkv at N∈{1024,4096} ran at
+~48% of HBM peak; fused N=(H+2Hkv)·Dh escapes that regime).
+
+Fusion is a build-time layout choice, never a numerics choice: the fused
+tensor is an ordinary stacked QuantizedTensor whose matmul output columns
+are exactly the members' outputs side by side.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.models.llama import llama_spec
+from distributed_inference_engine_tpu.ops import quant
+from distributed_inference_engine_tpu.ops.int4_matmul import set_kernel_mode
+
+
+@pytest.fixture
+def kernel_on():
+    set_kernel_mode("on")
+    yield
+    set_kernel_mode("auto")
+
+
+def _spec():
+    return llama_spec("llama-tiny", max_seq_len=64).replace(
+        d_model=256, d_ff=256, n_heads=4, n_kv_heads=2, dtype="float32")
+
+
+def _params(spec):
+    return quant.random_quantized_params(spec, jax.random.key(0), bits=4)
+
+
+def test_fuse_builds_expected_keys_and_shapes(kernel_on):
+    spec = _spec()
+    fused = quant.fuse_block_weights(_params(spec))["blocks"]
+    assert "w_qkv" in fused and "w_gate_up" in fused
+    for gone in ("wq", "wk", "wv", "w_gate", "w_up"):
+        assert gone not in fused
+    L, D, F = spec.n_layers, spec.d_model, spec.d_ff
+    n_qkv = (spec.n_heads + 2 * spec.n_kv_heads) * spec.head_dim
+    assert fused["w_qkv"].q.shape == (L, D // 2, n_qkv)
+    assert fused["w_qkv"].s.shape == (L, 1, n_qkv)
+    assert fused["w_gate_up"].q.shape == (L, D // 2, 2 * F)
+    # untouched members survive
+    assert fused["w_down"].q.shape == (L, F // 2, D)
+
+
+def test_fuse_is_identity_when_kernel_off():
+    set_kernel_mode("off")
+    try:
+        params = _params(_spec())
+        assert quant.fuse_block_weights(params) is params
+    finally:
+        set_kernel_mode("auto")
+
+
+def test_fuse_is_idempotent(kernel_on):
+    params = _params(_spec())
+    once = quant.fuse_block_weights(params)
+    assert quant.fuse_block_weights(once) is once
+
+
+def test_fuse_skipped_for_int8(kernel_on):
+    params = quant.random_quantized_params(_spec(), jax.random.key(0), bits=8)
+    assert quant.fuse_block_weights(params) is params
+
+
+def test_fuse_skipped_when_biases_present(kernel_on):
+    params = _params(_spec())
+    blocks = dict(params["blocks"])
+    blocks["bq"] = jnp.zeros((2, 256))
+    fused = quant.fuse_block_weights({**params, "blocks": blocks})["blocks"]
+    assert "w_qkv" not in fused and "wq" in fused
+    assert "w_gate_up" in fused          # mlp group fuses independently
+
+
+def test_fused_forward_matches_unfused(kernel_on):
+    """Same quantized values, same scales, concat-then-split: each fused
+    output column sums the same products as its unfused counterpart, so
+    the trees agree to dot-reassociation noise (XLA tiles the wider-N
+    dot differently — bitwise equality does NOT hold, tolerance does)."""
+    from distributed_inference_engine_tpu.models.base import forward_prefill
+
+    spec = _spec()
+    params = _params(spec)
+    fused = quant.fuse_block_weights(params)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, spec.vocab_size, size=(2, 16)))
+    lens = jnp.asarray([16, 9])
+    h_ref, k_ref, v_ref = forward_prefill(spec, params, tokens, lens)
+    h_got, k_got, v_got = forward_prefill(spec, fused, tokens, lens)
+    np.testing.assert_allclose(np.asarray(h_got), np.asarray(h_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(k_got), np.asarray(k_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(v_got), np.asarray(v_ref),
+                               rtol=1e-4, atol=1e-4)
