@@ -58,14 +58,53 @@ MODEL = os.environ.get("BENCH_MODEL", "llama3-8b")
 IS_BIG = "8b" in MODEL or "7b" in MODEL
 # BENCH_QUANT: 0 = full precision, 1/8 = int8 weight-only, 4 = packed int4.
 # Default for the 8B class is int4 — the fastest measured config since the
-# r4 stacked Mosaic kernel (4,254 tok/s vs int8's 3,661 at bs64)
+# r4 stacked Mosaic kernel (4,254 tok/s vs int8's 3,661 at bs64). The
+# default is DOWNGRADED to int8 by resolve_quant() when the Mosaic kernel
+# cannot engage (MoE expert weights are 4-D; multi-device processes kept
+# the XLA path until r5's shard_map wrapper): the pure-XLA int4 path
+# measured 1,584 tok/s — a silent 2.3x loss vs int8 (ADVICE r4).
+_Q_EXPLICIT = "BENCH_QUANT" in os.environ
 _Q = os.environ.get("BENCH_QUANT", "4" if IS_BIG else "0")
 QUANT = _Q not in ("0", "")
 QUANT_BITS = 4 if _Q == "4" else 8
+
+
+def resolve_quant(spec) -> None:
+    """Finalize the quant default once the model spec is known (ADVICE
+    r4): a DEFAULTED int4 drops to int8 when the Mosaic kernel cannot
+    take the weights under ANY mode — i.e. MoE specs, whose 4-D expert
+    payloads the stacked kernel rejects. Multi-device processes no
+    longer downgrade: sharded int4 params flip the kernel to its
+    GSPMD-partitionable "cp" mode at engine init (r5). An EXPLICIT
+    BENCH_QUANT=4 on a MoE spec is honored but logged."""
+    global QUANT_BITS, BATCH
+    if not (QUANT and QUANT_BITS == 4) or not spec.n_experts:
+        return
+    if _Q_EXPLICIT:
+        log("WARNING: BENCH_QUANT=4 on a MoE spec — expert weights are "
+            "4-D, the Mosaic kernel disengages, and the XLA int4 path "
+            "measured 2.3x slower than int8")
+    else:
+        log("int4 default downgraded to int8: MoE expert weights are 4-D")
+        QUANT_BITS = 8
+        if _BIG_INT4_CONT and "BENCH_BATCH" not in os.environ:
+            # the bs128 default rode the int4 assumption (int8 bs128
+            # with bf16 KV does not fit a 16 GB chip — README table);
+            # re-derive alongside the quant downgrade
+            BATCH = 64
+            log("batch default re-derived to 64 (int8 bs128 needs fp8 KV)")
 ENGINE_KIND = os.environ.get("BENCH_ENGINE", "continuous")
-# default 64 slots: the throughput-serving configuration (batch sweep in
-# README — aggregate tok/s scales ~5x from bs8 while TTFT stays sub-second)
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+# default slots: the throughput-serving configuration. The 8B int4
+# continuous flagship moved to bs128 in r5 — int4 frees enough HBM that
+# bs128 fits with bf16 KV, and weights amortize over 2x the tokens:
+# 5,315 tok/s vs 4,639 at bs64 (fp8 KV at bs128 measured SLOWER, 4,634 —
+# the convert overhead now outweighs the saved KV bandwidth, so fp8 KV
+# is a capacity lever only on this engine). Other configs keep bs64
+# (batch sweep in README — aggregate tok/s scales ~5x from bs8 while
+# TTFT stays sub-second).
+_BIG_INT4_CONT = IS_BIG and _Q == "4" and \
+    os.environ.get("BENCH_ENGINE", "continuous") == "continuous"
+BATCH = int(os.environ.get("BENCH_BATCH", "128" if _BIG_INT4_CONT else "64"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
@@ -281,6 +320,7 @@ def _requests(spec, seed: int, n: int):
 def decode_main() -> None:
     """Batch-decode throughput rung (static or continuous engine)."""
     spec = _spec()
+    resolve_quant(spec)
     # continuous default chunk 128 (= NEW_TOKENS): with the round-3 dense-
     # ctx chunk scheme the whole decode runs as ONE chunk — one ctx gather,
     # one host sync — measuring 3623 tok/s at 8B bs64 vs 3173 at chunk 64
@@ -293,6 +333,11 @@ def decode_main() -> None:
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
     engine = _engine(spec, params, ENGINE_KIND, BATCH, steps)
+    # drop the pre-fusion tree reference: the engine's prepare_params
+    # replaced qkv/gate+up members with fused payloads, and holding the
+    # originals alive here pins ~2.2 GB of dead HBM — enough to OOM the
+    # int4 bs128 rung on a 16 GB chip (engine.params is the live tree)
+    params = None
     log(f"engine init ({MODEL}, {ENGINE_KIND}, "
         f"quant={QUANT_BITS if QUANT else 0}): "
         f"{time.perf_counter() - t0:.1f}s")
@@ -370,6 +415,7 @@ def serving_main() -> None:
     )
 
     spec = _spec()
+    resolve_quant(spec)
     # default offered load ~near capacity: an 8B chip serves ~4 requests/s
     # of 128 fresh tokens; small models far more
     rate = float(os.environ.get("BENCH_RATE", "4" if IS_BIG else "16"))
@@ -379,6 +425,7 @@ def serving_main() -> None:
     t0 = time.perf_counter()
     params = _build_params(spec, QUANT)
     engine = _engine(spec, params, "continuous", BATCH, steps)
+    params = None                     # see decode_main: free pre-fusion tree
     # overload handling on by default in serving mode: past saturation the
     # engine sheds (typed error) instead of growing an unbounded queue, so
     # the latency curve has a knee instead of a cliff (VERDICT r2 item 2)
