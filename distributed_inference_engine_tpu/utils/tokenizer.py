@@ -159,6 +159,7 @@ class BPETokenizer:
 
     @classmethod
     def from_pretrained_dir(cls, path: str, **kw) -> "BPETokenizer":
+        """GPT-2-era checkpoint layout: vocab.json + merges.txt."""
         p = pathlib.Path(path)
         vocab = json.loads((p / "vocab.json").read_text())
         merges = []
@@ -168,6 +169,24 @@ class BPETokenizer:
             a, b = line.split()
             merges.append((a, b))
         return cls(vocab, merges, **kw)
+
+    @classmethod
+    def from_tokenizer_json(cls, path: str, **kw) -> "BPETokenizer":
+        """Modern HF layout (Llama-3, Qwen2, Mistral): one tokenizer.json
+        whose ``model`` section carries the same byte-level-BPE vocab and
+        merge list the GPT-2-era split files did. Merges appear either as
+        "a b" strings (tokenizers <0.20 serialization) or [a, b] pairs."""
+        d = json.loads(pathlib.Path(path).read_text())
+        model = d.get("model", {})
+        if model.get("type") != "BPE":
+            raise ValueError(
+                f"tokenizer.json model type {model.get('type')!r} is not "
+                "BPE — only byte-level BPE tokenizers are supported")
+        merges: List[Tuple[str, str]] = []
+        for m in model.get("merges", []):
+            a, b = m.split(" ", 1) if isinstance(m, str) else m
+            merges.append((a, b))
+        return cls(model["vocab"], merges, **kw)
 
     # GPT-2's pre-tokenization pattern: merges only apply WITHIN these
     # chunks (contractions / space-prefixed words / numbers / punctuation /
@@ -208,9 +227,17 @@ class BPETokenizer:
 
 
 def build_tokenizer(path: str = "") -> object:
-    """Checkpoint dir with vocab.json+merges.txt -> BPE; else byte-level."""
+    """Checkpoint-dir tokenizer discovery, one rule for every caller:
+    vocab.json+merges.txt (GPT-2 era) or tokenizer.json (Llama-3/Qwen2
+    era, byte-level BPE) -> ``BPETokenizer``; else byte-level fallback."""
     if path:
         p = pathlib.Path(path)
         if (p / "vocab.json").exists() and (p / "merges.txt").exists():
             return BPETokenizer.from_pretrained_dir(path)
+        if (p / "tokenizer.json").exists():
+            try:
+                return BPETokenizer.from_tokenizer_json(
+                    str(p / "tokenizer.json"))
+            except (ValueError, KeyError):
+                pass                     # non-BPE tokenizer: byte fallback
     return ByteTokenizer()

@@ -22,6 +22,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+# serving stays at bs64: the r5 bs128 decode default assumes the batch
+# bench's memory shape — serving adds per-bucket compiled programs and
+# admission-prefill workspace on top, and bs128 OOMs the 16 GB chip
+os.environ.setdefault("BENCH_BATCH", "64")
 
 import numpy as np  # noqa: E402
 

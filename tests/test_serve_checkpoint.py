@@ -1,0 +1,137 @@
+"""End-to-end real-checkpoint serving path (examples/serve_checkpoint.py):
+a synthetic HF checkpoint directory — config.json + model.safetensors +
+vocab.json/merges.txt — goes through spec_from_hf_config →
+load_checkpoint → (optional) quantize_params → BPETokenizer → continuous
+engine → detokenized text. This is the committed proof behind the README
+"Real-checkpoint status" note: the environment has no real weights, but
+the full path a user with weights runs is driven here token-for-token.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path):
+    from safetensors.numpy import save_file
+
+    # n_kv_heads*head_dim must be a multiple of 128 (paged-KV lane rule)
+    D, F, V, L, H, Hkv = 128, 64, 300, 2, 4, 4
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "vocab_size": V, "hidden_size": D, "num_hidden_layers": L,
+        "num_attention_heads": H, "num_key_value_heads": Hkv,
+        "intermediate_size": F, "max_position_embeddings": 64,
+        "rope_theta": 10000.0, "rms_norm_eps": 1e-5,
+        "torch_dtype": "float32", "eos_token_id": 299,
+    }))
+    rs = np.random.RandomState(0)
+    Hd, Kd = D, D
+    raw = {
+        "model.embed_tokens.weight": rs.randn(V, D).astype(np.float32) * .05,
+        "model.norm.weight": np.ones(D, dtype=np.float32),
+        "lm_head.weight": rs.randn(V, D).astype(np.float32) * .05,
+    }
+    for l in range(L):
+        raw[f"model.layers.{l}.input_layernorm.weight"] = np.ones(D, np.float32)
+        raw[f"model.layers.{l}.post_attention_layernorm.weight"] = \
+            np.ones(D, np.float32)
+        raw[f"model.layers.{l}.self_attn.q_proj.weight"] = \
+            rs.randn(Hd, D).astype(np.float32) * .05
+        raw[f"model.layers.{l}.self_attn.k_proj.weight"] = \
+            rs.randn(Kd, D).astype(np.float32) * .05
+        raw[f"model.layers.{l}.self_attn.v_proj.weight"] = \
+            rs.randn(Kd, D).astype(np.float32) * .05
+        raw[f"model.layers.{l}.self_attn.o_proj.weight"] = \
+            rs.randn(D, Hd).astype(np.float32) * .05
+        raw[f"model.layers.{l}.mlp.gate_proj.weight"] = \
+            rs.randn(F, D).astype(np.float32) * .05
+        raw[f"model.layers.{l}.mlp.up_proj.weight"] = \
+            rs.randn(F, D).astype(np.float32) * .05
+        raw[f"model.layers.{l}.mlp.down_proj.weight"] = \
+            rs.randn(D, F).astype(np.float32) * .05
+    save_file(raw, str(tmp_path / "model.safetensors"))
+
+    # GPT-2-style byte-level BPE files: bytes 0-255 as latin-1-ish chars
+    # plus a couple of merges, exactly the HF on-disk format
+    from distributed_inference_engine_tpu.utils.tokenizer import (
+        _bytes_to_unicode,
+    )
+
+    b2u = _bytes_to_unicode()
+    vocab = {ch: i for i, ch in enumerate(b2u[b] for b in range(256))}
+    he = b2u[ord("h")] + b2u[ord("e")]
+    vocab[he] = 256
+    hel = he + b2u[ord("l")]
+    vocab[hel] = 257
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text(
+        "#version: 0.2\n"
+        f"{b2u[ord('h')]} {b2u[ord('e')]}\n"
+        f"{he} {b2u[ord('l')]}\n")
+    return tmp_path
+
+
+@pytest.mark.parametrize("quant", [0, 4])
+def test_serve_checkpoint_end_to_end(ckpt_dir, quant):
+    import sys
+
+    sys.path.insert(0, "examples")
+    from serve_checkpoint import build_engine
+
+    from distributed_inference_engine_tpu.engine.types import (
+        GenerationRequest,
+    )
+
+    engine, tok, eos_ids = build_engine(str(ckpt_dir), quant=quant,
+                                        max_slots=2, max_seq_len=64)
+    assert eos_ids == [299]        # read from config.json
+    ids = tok.encode("hello")
+    assert ids[0] == 257, ids      # "hel" merge applied: BPE files honored
+    res = engine.generate([GenerationRequest(
+        prompt=ids, max_new_tokens=6, temperature=0.0, request_id="t")])[0]
+    assert len(res.tokens) == 6
+    text = tok.decode(res.tokens)
+    assert isinstance(text, str)        # round-trips through the detokenizer
+    # quantized serving of a LOADED checkpoint matches shapes/dtype rules
+    if quant:
+        from distributed_inference_engine_tpu.ops.quant import (
+            QuantizedTensor,
+        )
+
+        assert isinstance(engine.params["lm_head"], QuantizedTensor)
+        assert engine.params["lm_head"].bits == 4
+
+
+def test_tokenizer_json_layout(ckpt_dir):
+    """Modern HF checkpoints (Llama-3/Qwen2) ship one tokenizer.json;
+    build_tokenizer must parse it to the SAME tokenizer the split
+    vocab.json+merges.txt files produce."""
+    from distributed_inference_engine_tpu.utils.tokenizer import (
+        BPETokenizer,
+        build_tokenizer,
+    )
+
+    split = build_tokenizer(str(ckpt_dir))
+    vocab = json.loads((ckpt_dir / "vocab.json").read_text())
+    merges = [line.split() for line in
+              (ckpt_dir / "merges.txt").read_text().splitlines()[1:]]
+    (ckpt_dir / "tokenizer.json").write_text(json.dumps({
+        "model": {"type": "BPE", "vocab": vocab,
+                  "merges": [f"{a} {b}" for a, b in merges]}}))
+    (ckpt_dir / "vocab.json").unlink()
+    (ckpt_dir / "merges.txt").unlink()
+    single = build_tokenizer(str(ckpt_dir))
+    assert isinstance(single, BPETokenizer)
+    for text in ("hello", "hell", "he said hello"):
+        assert single.encode(text) == split.encode(text)
+    # non-BPE tokenizer.json degrades to the byte fallback, not an error
+    (ckpt_dir / "tokenizer.json").write_text(json.dumps({
+        "model": {"type": "Unigram"}}))
+    from distributed_inference_engine_tpu.utils.tokenizer import (
+        ByteTokenizer,
+    )
+
+    assert isinstance(build_tokenizer(str(ckpt_dir)), ByteTokenizer)
