@@ -433,6 +433,10 @@ def serving_main() -> None:
         os.environ.get("BENCH_MAX_WAITING", str(4 * BATCH)))
     engine.config.queue_deadline_s = float(
         os.environ.get("BENCH_DEADLINE_S", "10"))
+    engine.config.admission_min_batch = int(
+        os.environ.get("BENCH_ADMIT_MIN", "0"))
+    engine.config.admission_max_hold_s = float(
+        os.environ.get("BENCH_ADMIT_HOLD", "0.25"))
     log(f"engine init ({MODEL}, serving, quant={QUANT_BITS if QUANT else 0}): "
         f"{time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()

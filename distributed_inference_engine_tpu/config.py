@@ -144,6 +144,24 @@ class EngineConfig:
                                        # finish_reason="overloaded" (pump/
                                        # RPC surface it as the typed error;
                                        # 0 = never shed)
+    # ---- admission coalescing (r5, serving-goodput lever) ----
+    admission_min_batch: int = 0       # hold waiting admissions until this
+                                       # many queue up (or the hold timer
+                                       # below fires): admission prefill at
+                                       # 4-8 rows runs far below the
+                                       # batched-prefill rate, so trading
+                                       # ~a chunk of queue wait for 2x the
+                                       # prefill batch raises goodput near
+                                       # saturation. 0 = admit immediately
+                                       # (the default; latency-optimal at
+                                       # light load). Held admissions jump
+                                       # the hold when the decode batch is
+                                       # running under half-occupied —
+                                       # stalling a hungry engine never
+                                       # wins.
+    admission_max_hold_s: float = 0.25  # cap on the coalescing hold: the
+                                       # oldest waiting request never waits
+                                       # longer than this for batch-mates
 
 
 @dataclass

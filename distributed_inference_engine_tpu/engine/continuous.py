@@ -853,6 +853,28 @@ class ContinuousEngine:
             self._install_device(
                 [self._slot_row(req, slot, prompt_len, first)])
 
+    def _should_hold_admissions(self) -> bool:
+        """Admission coalescing (``admission_min_batch``): near saturation
+        a 4-8-row admission prefill runs far below the batched-prefill
+        rate, so waiting ~a chunk for batch-mates trades a little queue
+        latency for MXU-shaped prefill batches. Never holds when the
+        decode batch is running under half-occupied (a hungry engine
+        beats a bigger prefill), and never past ``admission_max_hold_s``
+        for the oldest waiting request."""
+        mb = self.config.admission_min_batch
+        if not mb or not self._waiting:
+            return False
+        live = len(self._slots) + len(self._prefilling)
+        # the admission batch is capped by free slots: once the queue can
+        # already fill them, holding adds TTFT with zero batching gain
+        if len(self._waiting) >= min(mb, self.max_slots - live):
+            return False
+        if live * 2 < self.max_slots:
+            return False                       # engine hungry: admit now
+        oldest_t = self._waiting[0][2]
+        return (time.perf_counter() - oldest_t
+                < self.config.admission_max_hold_s)
+
     def _try_admit(self) -> int:
         """Prefill waiting requests into free slots; returns #admitted.
 
@@ -864,6 +886,8 @@ class ContinuousEngine:
         """
         self._shed_expired()
         admitted = self._admit_prefilled()
+        if self._should_hold_admissions():
+            return admitted
         # rows: (req, cb, slot, tokens-to-prefill, t_submit, full_prompt);
         # full_prompt is None for whole-prompt admissions, the complete
         # prompt for the FIRST CHUNK of a chunked admission (which rides

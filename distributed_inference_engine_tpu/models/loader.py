@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import json
 import pathlib
-from typing import Any, Dict, Iterator, Tuple
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -211,15 +211,18 @@ def _llama_like(cfg: Dict[str, Any], **quirks: Any) -> ModelSpec:
     return ModelSpec(**base).validate()
 
 
-def spec_from_hf_config(path: str) -> ModelSpec:
+def spec_from_hf_config(path: str, cfg: Optional[dict] = None) -> ModelSpec:
     """Build a ModelSpec from a HF ``config.json``.
 
     Matches on ``model_type`` (authoritative in HF configs) with the
     architectures[] string as fallback. Unsupported relatives that share a
     name prefix (gemma2/gemma3, qwen3, ...) must NOT fall through to a
     near-miss spec — loading e.g. a Gemma-2 checkpoint as Gemma-1 would run
-    without error and generate garbage — so matching is exact."""
-    cfg = json.loads((pathlib.Path(path) / "config.json").read_text())
+    without error and generate garbage — so matching is exact.
+    ``cfg``: pass the already-parsed config.json dict to skip the read
+    (callers that also need other fields, e.g. eos_token_id)."""
+    if cfg is None:
+        cfg = json.loads((pathlib.Path(path) / "config.json").read_text())
     arch = (cfg.get("architectures") or [""])[0].lower()
     mt = cfg.get("model_type", "")
 

@@ -172,21 +172,38 @@ class BPETokenizer:
 
     @classmethod
     def from_tokenizer_json(cls, path: str, **kw) -> "BPETokenizer":
-        """Modern HF layout (Llama-3, Qwen2, Mistral): one tokenizer.json
-        whose ``model`` section carries the same byte-level-BPE vocab and
-        merge list the GPT-2-era split files did. Merges appear either as
-        "a b" strings (tokenizers <0.20 serialization) or [a, b] pairs."""
+        """Modern HF layout (Llama-3, Qwen2): one tokenizer.json whose
+        ``model`` section carries the same byte-level-BPE vocab and merge
+        list the GPT-2-era split files did. Merges appear either as "a b"
+        strings (tokenizers <0.20 serialization) or [a, b] pairs.
+        Top-level ``added_tokens`` (where Llama-3-era specials like
+        <|eot_id|> live, OUTSIDE model.vocab) merge into the vocab so
+        eos ids decode and ``vocab_size`` matches the checkpoint.
+
+        Raises ValueError for non-byte-level tokenizers — model.type
+        "BPE" alone is not enough (Llama-2/Mistral-v0.1 serialize
+        SentencePiece-style BPE with a metasymbol vocab under the same
+        type; encoding through the byte-unit table would silently drop
+        most bytes), so the byte-unit alphabet itself is checked."""
         d = json.loads(pathlib.Path(path).read_text())
         model = d.get("model", {})
         if model.get("type") != "BPE":
             raise ValueError(
                 f"tokenizer.json model type {model.get('type')!r} is not "
                 "BPE — only byte-level BPE tokenizers are supported")
+        vocab = dict(model["vocab"])
+        covered = sum(1 for u in _bytes_to_unicode().values() if u in vocab)
+        if covered < 250:               # byte-level vocabs carry all 256
+            raise ValueError(
+                f"tokenizer.json vocab covers only {covered}/256 byte "
+                "units — a SentencePiece-style BPE, not byte-level")
+        for t in d.get("added_tokens", []):
+            vocab.setdefault(t["content"], t["id"])
         merges: List[Tuple[str, str]] = []
         for m in model.get("merges", []):
             a, b = m.split(" ", 1) if isinstance(m, str) else m
             merges.append((a, b))
-        return cls(model["vocab"], merges, **kw)
+        return cls(vocab, merges, **kw)
 
     # GPT-2's pre-tokenization pattern: merges only apply WITHIN these
     # chunks (contractions / space-prefixed words / numbers / punctuation /

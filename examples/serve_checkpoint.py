@@ -30,7 +30,10 @@ def log(msg: str) -> None:
 
 def build_engine(path: str, quant: int = 0, max_slots: int = 4,
                  max_seq_len: int = 0):
-    """(engine, tokenizer) serving the checkpoint at ``path``."""
+    """(engine, tokenizer, eos_ids) serving the checkpoint at ``path``;
+    ``eos_ids`` comes from config.json's eos_token_id (possibly several —
+    wire [0] into ``GenerationRequest.eos_id`` and the rest into
+    ``stop_ids``, as main() does)."""
     from distributed_inference_engine_tpu.config import EngineConfig
     from distributed_inference_engine_tpu.engine.continuous import (
         ContinuousEngine,
@@ -45,9 +48,12 @@ def build_engine(path: str, quant: int = 0, max_slots: int = 4,
         build_tokenizer,
     )
 
+    import json
+
     p = pathlib.Path(path)
     t0 = time.perf_counter()
-    spec = spec_from_hf_config(str(p))
+    hf_cfg = json.loads((p / "config.json").read_text())   # parsed ONCE:
+    spec = spec_from_hf_config(str(p), cfg=hf_cfg)         # spec + eos
     if max_seq_len:
         spec = spec.replace(max_seq_len=min(spec.max_seq_len, max_seq_len))
     params = load_checkpoint(str(p), spec)
@@ -75,9 +81,7 @@ def build_engine(path: str, quant: int = 0, max_slots: int = 4,
     # eos: config.json's eos_token_id is authoritative (a list for
     # multi-eos checkpoints like Llama-3 — the engine takes one id; the
     # rest ride GenerationRequest.stop_ids in main())
-    import json as _json
-
-    eos = _json.loads((p / "config.json").read_text()).get("eos_token_id")
+    eos = hf_cfg.get("eos_token_id")
     eos_ids = ([] if eos is None
                else [eos] if isinstance(eos, int) else list(eos))
     return ContinuousEngine(spec, params=params, config=cfg), tok, eos_ids

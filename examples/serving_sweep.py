@@ -106,6 +106,16 @@ def main():
         os.environ.get("BENCH_MAX_WAITING", str(bench.BATCH)))
     engine.config.queue_deadline_s = float(
         os.environ.get("BENCH_DEADLINE_S", "8"))
+    # admission coalescing (r5): BENCH_ADMIT_MIN=16 holds admissions for
+    # up to BENCH_ADMIT_HOLD seconds until 16 queue up
+    engine.config.admission_min_batch = int(
+        os.environ.get("BENCH_ADMIT_MIN", "0"))
+    engine.config.admission_max_hold_s = float(
+        os.environ.get("BENCH_ADMIT_HOLD", "0.25"))
+    # BENCH_DEFER_ADMIT=0: synchronous first-token reads at admission —
+    # TTFT drops ~a chunk at some goodput cost (the latency-SLO knee)
+    if os.environ.get("BENCH_DEFER_ADMIT", "") == "0":
+        engine.config.defer_admission = False
     log(f"engine init ({bench.MODEL}, bs{bench.BATCH}, "
         f"quant={bench.QUANT_BITS if bench.QUANT else 0}, "
         f"max_waiting={engine.config.max_waiting}, "

@@ -383,3 +383,37 @@ def test_page_boundary_pause_revives_under_defer_sync():
     cont = ContinuousEngine(SPEC, config=cfg, seed=0)
     out = cont.generate(req)
     assert len(out[0].tokens) == 16, out[0].tokens
+
+
+def test_admission_coalescing_holds_then_admits():
+    """admission_min_batch holds a lone waiting request while the decode
+    batch is busy, admits once the hold expires (or batch-mates arrive),
+    and never holds a hungry engine."""
+    import time as _time
+
+    cfg = _cfg(max_slots=4)
+    cfg.admission_min_batch = 4
+    cfg.admission_max_hold_s = 0.15
+    cont = ContinuousEngine(SPEC, config=cfg, seed=0)
+    rs = np.random.RandomState(5)
+    # engine idle (0 live slots < half): hold must NOT apply
+    cont.submit(_reqs(rs, 1, max_new=30)[0])
+    cont.step()
+    assert cont.n_live == 1
+    # fill to exactly half occupancy (2 live, 2 free): not hungry, and
+    # free slots exceed the queue -> a lone request must wait for mates
+    for r in _reqs(rs, 1, max_new=30):
+        cont.submit(r)
+    cont.step()
+    assert cont.n_live == 2
+    lone = GenerationRequest(prompt=[7, 8, 9], max_new_tokens=4,
+                             temperature=0.0, request_id="lone")
+    cont.submit(lone)
+    cont.step()
+    assert cont.n_waiting == 1          # held: min_batch not reached
+    _time.sleep(0.2)                    # hold timer expires
+    cont.step()
+    assert cont.n_waiting == 0          # admitted on timeout
+    out = cont.run_until_idle()
+    lone_res = next(r for r in out if r.request_id == "lone")
+    assert len(lone_res.tokens) == 4

@@ -76,9 +76,11 @@ def ckpt_dir(tmp_path):
 
 @pytest.mark.parametrize("quant", [0, 4])
 def test_serve_checkpoint_end_to_end(ckpt_dir, quant):
+    import pathlib
     import sys
 
-    sys.path.insert(0, "examples")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "examples"))
     from serve_checkpoint import build_engine
 
     from distributed_inference_engine_tpu.engine.types import (
@@ -127,11 +129,28 @@ def test_tokenizer_json_layout(ckpt_dir):
     assert isinstance(single, BPETokenizer)
     for text in ("hello", "hell", "he said hello"):
         assert single.encode(text) == split.encode(text)
-    # non-BPE tokenizer.json degrades to the byte fallback, not an error
+    # added_tokens (Llama-3-era specials living OUTSIDE model.vocab)
+    # merge in: the eos id must decode instead of silently dropping
     (ckpt_dir / "tokenizer.json").write_text(json.dumps({
-        "model": {"type": "Unigram"}}))
+        "added_tokens": [{"id": 299, "content": "<|eot|>"}],
+        "model": {"type": "BPE", "vocab": vocab,
+                  "merges": [f"{a} {b}" for a, b in merges]}}))
+    with_added = build_tokenizer(str(ckpt_dir))
+    assert with_added.vocab["<|eot|>"] == 299
+    assert with_added.decode([299]) == "<|eot|>"
     from distributed_inference_engine_tpu.utils.tokenizer import (
         ByteTokenizer,
     )
 
+    # non-BPE tokenizer.json degrades to the byte fallback, not an error
+    (ckpt_dir / "tokenizer.json").write_text(json.dumps({
+        "model": {"type": "Unigram"}}))
+    assert isinstance(build_tokenizer(str(ckpt_dir)), ByteTokenizer)
+    # SentencePiece-style BPE (type "BPE" but a metasymbol vocab without
+    # the byte-unit alphabet — Llama-2/Mistral-v0.1) must ALSO fall back:
+    # byte-level encoding through it would silently drop most bytes
+    (ckpt_dir / "tokenizer.json").write_text(json.dumps({
+        "model": {"type": "BPE",
+                  "vocab": {"▁hello": 0, "▁world": 1},
+                  "merges": []}}))
     assert isinstance(build_tokenizer(str(ckpt_dir)), ByteTokenizer)
