@@ -82,6 +82,13 @@ def parse_xplane(trace_dir: str):
                       recursive=True)
     if not paths:
         raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    # the profiler writes plugins/profile/<timestamp>/; a reused trace_dir
+    # accumulates captures across runs and summing them MERGES profiles
+    # (caught in r5: the int4 table silently included the r4 int8 capture
+    # from hours earlier — numbers matched the old table to the 0.1 ms).
+    # Parse the NEWEST capture only.
+    latest = max(os.path.dirname(p) for p in paths)
+    paths = [p for p in paths if os.path.dirname(p) == latest]
     per_op = collections.Counter()
     total_ps = 0
     module_ps = 0
