@@ -278,11 +278,18 @@ def embed(spec: ModelSpec, params: Params, tokens: jnp.ndarray,
 
 
 def unembed(spec: ModelSpec, params: Params, hidden: jnp.ndarray) -> jnp.ndarray:
-    """Final norm + LM head. hidden [..., D] -> fp32 logits [..., V]."""
+    """Final norm + LM head. hidden [..., D] -> fp32 logits [..., V].
+
+    An int4 lm_head may arrive VOCAB-PADDED (``ops.quant``: V=128256 =
+    256·501 tiles the Mosaic kernel only at bn=256, ~338 GB/s; padded to
+    a 2048-multiple it rides the big-block path) — pad columns are
+    zero-weight and sliced off here before softcap/sampling."""
     h = _norm(spec, hidden, params["lnf_scale"], params.get("lnf_bias"))
     w = params["tok_emb"].T if spec.tie_embeddings else params["lm_head"]
     if isinstance(w, QuantizedTensor):
         logits = matmul_any("...d,dv->...v", h.astype(jnp.float32), w)
+        if logits.shape[-1] != spec.vocab_size:
+            logits = logits[..., : spec.vocab_size]
     else:
         # keep the [D, V] projection in its storage dtype (bf16: half the HBM
         # read of an fp32 upcast — this matmul streams the largest single

@@ -416,6 +416,19 @@ _MOE_WEIGHTS: Dict[str, Tuple[int, ...]] = {
 }
 
 
+# int4 lm_head vocab padding (r5, decode-profile lever): V=128256 =
+# 256·501 tiles the Mosaic kernel only at bn=256 (~338 GB/s measured);
+# padded to the next 2048-multiple it takes the big-block path. Pad
+# columns are ZERO weights (their per-channel scale is the 1e-8 floor),
+# so their logits are exactly 0 and models.base.unembed slices them off
+# before softcap/sampling.
+_LM_HEAD_PAD = 2048
+
+
+def _pad_vocab(n: int) -> int:
+    return -(-n // _LM_HEAD_PAD) * _LM_HEAD_PAD
+
+
 def quantize_params(spec, params: Dict[str, Any],
                     bits: int = 8) -> Dict[str, Any]:
     """Quantize the big matmul weights of a loaded/initialised param tree
@@ -438,7 +451,11 @@ def quantize_params(spec, params: Dict[str, Any],
     out["blocks"] = blocks
     if (not spec.tie_embeddings and "lm_head" in out
             and not isinstance(out["lm_head"], QuantizedTensor)):
-        out["lm_head"] = quantize_weight(out["lm_head"], (0,), bits=bits)
+        w = out["lm_head"]
+        if bits == 4 and w.shape[1] != _pad_vocab(w.shape[1]):
+            w = jnp.pad(w, ((0, 0), (0, _pad_vocab(w.shape[1])
+                                     - w.shape[1])))
+        out["lm_head"] = quantize_weight(w, (0,), bits=bits)
     return out
 
 
@@ -522,6 +539,9 @@ def random_quantized_params(spec, key, w_std: float = 0.02,
         if name == "blocks":
             out[name] = blocks
         elif name == "lm_head" and not spec.tie_embeddings:
+            if bits == 4:                   # vocab-pad (see _pad_vocab)
+                leaf = jax.ShapeDtypeStruct(
+                    (leaf.shape[0], _pad_vocab(leaf.shape[1])), leaf.dtype)
             out[name] = q_leaf(leaf, (0,))
         else:
             out[name] = f_leaf(name, leaf)

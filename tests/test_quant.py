@@ -285,3 +285,36 @@ def test_int4_interleaved_checkpoint_repacks_on_restore():
     assert int(enc["layout"]) == 1
     qt2 = _decode_tree({"w": enc})["w"]
     np.testing.assert_array_equal(np.asarray(qt2._unpacked_int8()), vals)
+
+
+def test_int4_lm_head_vocab_padding_exact():
+    """int4 lm_heads vocab-pad to a 2048-multiple (kernel block tiling);
+    pad columns are zero-weight and unembed slices them off — logits for
+    REAL columns must be unchanged vs an unpadded quantization."""
+    import numpy as np
+
+    from distributed_inference_engine_tpu.models.base import unembed
+    from distributed_inference_engine_tpu.models.llama import llama_spec
+    from distributed_inference_engine_tpu.ops.quant import (
+        _pad_vocab,
+        quantize_params,
+        quantize_weight,
+    )
+
+    spec = llama_spec("llama-tiny", max_seq_len=32).replace(
+        d_model=256, d_ff=256, vocab_size=300, dtype="float32")
+    assert not spec.tie_embeddings
+    rs = np.random.RandomState(0)
+    from distributed_inference_engine_tpu.models.base import init_params
+
+    params = init_params(spec, jax.random.key(0))
+    q4 = quantize_params(spec, params, bits=4)
+    assert q4["lm_head"].shape == (256, _pad_vocab(300))
+    h = jnp.asarray(rs.randn(2, 256).astype("float32"))
+    got = unembed(spec, q4, h)
+    assert got.shape == (2, 300)            # sliced back to the real vocab
+    # reference: unpadded per-column quantization of the same weights
+    ref_w = quantize_weight(params["lm_head"], (0,), bits=4)
+    ref = unembed(spec, {**q4, "lm_head": ref_w}, h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
