@@ -162,6 +162,22 @@ class EngineConfig:
     admission_max_hold_s: float = 0.25  # cap on the coalescing hold: the
                                        # oldest waiting request never waits
                                        # longer than this for batch-mates
+    admission_max_rows: int = 0        # cap rows per admission-prefill
+                                       # dispatch (0 = whole free-slot
+                                       # set, the default). Historical
+                                       # safety valve: the two-program
+                                       # admission (prefill then page
+                                       # write) held a [L, bb, T, Hkv,
+                                       # Dh] x2 KV transient — ~2.1 GB at
+                                       # 8B bb=128, a NONDETERMINISTIC
+                                       # warmup OOM on 16 GB chips. The
+                                       # fused prefill (per-layer KV
+                                       # scattered into donated pools
+                                       # inside the scan, models.base.
+                                       # forward_prefill_into_pages)
+                                       # removed the transient; the cap
+                                       # remains for the sp path, which
+                                       # keeps the two-program shape.
 
 
 @dataclass

@@ -45,6 +45,7 @@ from ..models.base import (
     forward_decode,
     forward_decode_paged,
     forward_decode_window,
+    forward_prefill_into_pages,
     forward_prefill_suffix,
     init_params,
     unembed,
@@ -285,18 +286,37 @@ class ContinuousEngine:
 
         fwd_prefill = prefill_fn_for(spec_, sp_mesh, self.prefill_buckets)
 
+        def _sample_firsts(params, hidden, seq_lens, sampling, key):
+            """Shared prefill tail: last-token logits → sampled first
+            token + logprob, packed into ONE [2, B] int32 buffer (the
+            deferred-admission harvest contract — change it here and
+            BOTH admission programs stay in sync). Sampling happens
+            in-program because eager sampling is a dispatch chain that
+            wrecks TTFT on remote/tunnelled devices."""
+            last = hidden[jnp.arange(hidden.shape[0]), seq_lens - 1]
+            logits = unembed(spec_, params, last)
+            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
+            return jnp.stack(
+                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
+
         @jax.jit
         def _prefill(params, tokens, seq_lens, sampling, key):
             hidden, ks, vs = fwd_prefill(spec_, params, tokens, seq_lens)
-            last = hidden[jnp.arange(tokens.shape[0]), seq_lens - 1]
-            logits = unembed(spec_, params, last)
-            # sampled in-program: eager sampling is a dispatch chain that
-            # wrecks TTFT on remote/tunnelled devices. Token + logprob
-            # pack into one [2, B] int32 buffer (one blocking read).
-            first, lp = sample_tokens_with_logprobs(logits, sampling, key)
-            packed = jnp.stack(
-                [first, jax.lax.bitcast_convert_type(lp, jnp.int32)])
-            return packed, ks, vs
+            return (_sample_firsts(params, hidden, seq_lens, sampling, key),
+                    ks, vs)
+
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def _prefill_pages(params, tokens, seq_lens, kp, vp, table_rows,
+                           sampling, key):
+            """Fused admission prefill: per-layer KV scatters straight
+            into the (donated) pools inside the layer scan — no
+            [L, bb, T, Hkv, Dh] transient (~2.1 GB at 8B bb=128, the
+            nondeterministic bs128-warmup OOM) and one dispatch instead
+            of prefill + page-write."""
+            hidden, kp, vp = forward_prefill_into_pages(
+                spec_, params, tokens, seq_lens, kp, vp, table_rows)
+            return (_sample_firsts(params, hidden, seq_lens, sampling, key),
+                    kp, vp)
 
         page_size = self.kv.page_size
 
@@ -547,6 +567,9 @@ class ContinuousEngine:
         self._install = _install
         self._install_first = _install_first
         self._prefill = _prefill
+        # fused prefill+page-write for batched admissions; the sp path
+        # keeps the two-program shape (ring prefill returns stacked KV)
+        self._prefill_pages = None if has_sp else _prefill_pages
         self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
 
@@ -853,6 +876,13 @@ class ContinuousEngine:
             self._install_device(
                 [self._slot_row(req, slot, prompt_len, first)])
 
+    def _admit_row_cap(self) -> int:
+        """Rows per admission-prefill dispatch: bounds the [L, bb, T,
+        Hkv, Dh] x2 prefill-KV transient (config.admission_max_rows —
+        the bb=128 transient OOMed 16 GB chips nondeterministically)."""
+        cap = self.config.admission_max_rows
+        return min(self.max_slots, cap) if cap else self.max_slots
+
     def _should_hold_admissions(self) -> bool:
         """Admission coalescing (``admission_min_batch``): near saturation
         a 4-8-row admission prefill runs far below the batched-prefill
@@ -945,7 +975,7 @@ class ContinuousEngine:
                     # chunk advance takes over from there (done > 0 always)
                     batch.append((req, on_tok, slot, prompt[: self._chunk],
                                   t_submit, prompt))
-                    if len(batch) >= self.max_slots:
+                    if len(batch) >= self._admit_row_cap():
                         self._admit_batch(batch)
                         batch = []
                         pending_hashes.clear()
@@ -964,7 +994,7 @@ class ContinuousEngine:
                                    first_lp=first_lp)
             else:
                 batch.append((req, on_tok, slot, prompt, t_submit, None))
-                if len(batch) >= self.max_slots:
+                if len(batch) >= self._admit_row_cap():
                     self._admit_batch(batch)
                     batch = []
                     # flushed batches registered their pages — stale hashes
@@ -1005,13 +1035,23 @@ class ContinuousEngine:
                                   jnp.asarray(top_p), jnp.asarray(min_p))
         self._rng, k0 = jax.random.split(self._rng)
         seq_dev = jnp.asarray(seq_lens)
-        first_dev, ks, vs = self._prefill(
-            self.params, jnp.asarray(tokens), seq_dev, sampling, k0
-        )
-        kp, vp = self._write_pages(
-            self.kv.k_pages, self.kv.v_pages, ks, vs,
-            jnp.asarray(table_rows), seq_dev,
-        )
+        if self._prefill_pages is not None:
+            # fused path: per-layer KV scatters into the donated pools
+            # inside the prefill scan (pad rows' seq_len 0 drops every
+            # position, exactly like the two-program path's write)
+            first_dev, kp, vp = self._prefill_pages(
+                self.params, jnp.asarray(tokens), seq_dev,
+                self.kv.k_pages, self.kv.v_pages,
+                jnp.asarray(table_rows), sampling, k0,
+            )
+        else:                      # sp: ring prefill returns stacked KV
+            first_dev, ks, vs = self._prefill(
+                self.params, jnp.asarray(tokens), seq_dev, sampling, k0
+            )
+            kp, vp = self._write_pages(
+                self.kv.k_pages, self.kv.v_pages, ks, vs,
+                jnp.asarray(table_rows), seq_dev,
+            )
         self.kv.swap(kp, vp)
         # deferred admission: under decode pressure (≥1/4 of slots live),
         # skip the blocking first-token read — install the firsts device-

@@ -371,6 +371,69 @@ def _prefill_scan(
     return x, ks, vs, auxs.sum()
 
 
+def forward_prefill_into_pages(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,      # [B, T] right-padded prompts
+    seq_lens: jnp.ndarray,    # [B] true prompt lengths
+    k_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh] page pools (donated)
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, MP] physical pages per row
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill with each layer's fresh KV scattered STRAIGHT into the
+    page pools inside the layer scan — returns (hidden, k_pages,
+    v_pages) with no ``[L, B, T, Hkv, Dh]`` intermediate.
+
+    ``forward_prefill`` + ``write_prefill_pages`` materialize the full
+    stacked KV between the two programs: ~2.1 GB at 8B bb=128, which
+    made bs128 admission OOM a 16 GB chip nondeterministically (r5).
+    Here the pools ride the scan CARRY as flat [L·N·P, fused] views
+    (the decode chunk's established pattern) and each layer's [B, T,
+    fused] block scatters immediately — the transient is one layer's
+    KV (~33 MB at that shape). Padded positions get an out-of-range
+    flat index and ``mode="drop"`` discards them; the oob sentinel is
+    ABSOLUTE (L·N·P), never per-layer, so a padded token can't land in
+    the next layer's first page."""
+    b, t = tokens.shape
+    L = spec.n_layers
+    n, p = k_pages.shape[1], k_pages.shape[2]
+    fused = spec.n_kv_heads * spec.head_dim
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    x = embed(spec, params, tokens, positions)
+
+    def attn(q, k, v):
+        return causal_attention(q, k, v, seq_lens,
+                                window=spec.sliding_window)
+
+    valid = positions < seq_lens[:, None]
+    logical = positions // p
+    offset = positions % p
+    phys = jnp.take_along_axis(
+        page_table, jnp.minimum(logical, page_table.shape[1] - 1), axis=1)
+    base_idx = phys * p + offset                               # [B, T]
+
+    kp_flat = k_pages.reshape(L * n * p, fused)
+    vp_flat = v_pages.reshape(L * n * p, fused)
+    xs_blocks, rebuild = split_indexed_blocks(params["blocks"])
+
+    def body(carry, per_layer):
+        x, kpf, vpf = carry
+        xs_blk, l = per_layer
+        blk = rebuild(xs_blk, l)
+        x, k, v, _aux = transformer_block(spec, blk, x, positions, attn)
+        idx = jnp.where(valid, l * (n * p) + base_idx, L * n * p)
+        kpf = kpf.at[idx].set(k.reshape(b, t, fused).astype(kpf.dtype),
+                              mode="drop")
+        vpf = vpf.at[idx].set(v.reshape(b, t, fused).astype(vpf.dtype),
+                              mode="drop")
+        return (x, kpf, vpf), None
+
+    (x, kp_flat, vp_flat), _ = lax.scan(
+        body, (x, kp_flat, vp_flat), (xs_blocks, jnp.arange(L)))
+    return (x, kp_flat.reshape(L, n, p, fused),
+            vp_flat.reshape(L, n, p, fused))
+
+
 def forward_prefill_suffix(
     spec: ModelSpec,
     params: Params,

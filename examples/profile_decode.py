@@ -32,11 +32,12 @@ def classify(name: str, d_ff: int = 14336, vocab: int = 128256) -> str:
         return "int4 kernel (weights)"
     if "tpu_custom_call" in n or "pallas" in n:
         return "pallas kernel (other)"
-    # the int4 lm_head is vocab-PADDED to a 2048-multiple (ops.quant.
-    # _pad_vocab) — match both widths or padded-lm_head fusions silently
-    # land in the generic matmul bucket
-    vpad = -(-vocab // 2048) * 2048
-    if any(f"{v}]" in n or f",{v}" in n for v in {vocab, vpad}):
+    # the int4 lm_head is vocab-PADDED (ops.quant._pad_vocab) — match
+    # both widths or padded-lm_head fusions silently land in the generic
+    # matmul bucket
+    from distributed_inference_engine_tpu.ops.quant import _pad_vocab
+
+    if any(f"{v}]" in n or f",{v}" in n for v in {vocab, _pad_vocab(vocab)}):
         return "lm_head matmul + sampling"
     if "s8[" in n or "s4[" in n:
         if str(d_ff) in n:

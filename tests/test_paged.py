@@ -296,3 +296,48 @@ def test_pallas_stats_and_stacked_layer_match_xla():
         layer=jnp.asarray(1), n_pages_per_layer=n)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_forward_prefill_into_pages_matches_two_program_path():
+    """The fused admission prefill (per-layer KV scattered into the
+    pools inside the scan, r5) must produce byte-identical pools and
+    hidden states to forward_prefill + write_prefill_pages — including
+    a seq_len=0 pad row, whose positions must all drop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_inference_engine_tpu.models.base import (
+        ModelSpec,
+        forward_prefill,
+        forward_prefill_into_pages,
+        init_params,
+        write_prefill_pages,
+    )
+
+    spec = ModelSpec(vocab_size=128, d_model=256, n_layers=2, n_heads=4,
+                     n_kv_heads=2, d_ff=128, max_seq_len=64,
+                     dtype="float32")
+    params = init_params(spec, jax.random.key(0))
+    L, Hkv, Dh = 2, 2, 64
+    n_pages, page_size = 8, 16
+    fused = Hkv * Dh
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(1, 128, size=(4, 32)), jnp.int32)
+    seq_lens = jnp.asarray([32, 20, 5, 0], jnp.int32)   # incl. pad row
+    table = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0],
+                         [5, 0, 0, 0], [0, 0, 0, 0]], jnp.int32)
+    kp0 = jnp.full((L, n_pages, page_size, fused), -7.0, jnp.float32)
+    vp0 = jnp.full_like(kp0, -9.0)
+
+    h_ref, ks, vs = forward_prefill(spec, params, tokens, seq_lens)
+    kp_ref, vp_ref = write_prefill_pages(
+        kp0, vp0, ks, vs, table, seq_lens)
+    h_got, kp_got, vp_got = forward_prefill_into_pages(
+        spec, params, tokens, seq_lens, kp0, vp0, table)
+    np.testing.assert_array_equal(np.asarray(h_got), np.asarray(h_ref))
+    np.testing.assert_array_equal(np.asarray(kp_got), np.asarray(kp_ref))
+    np.testing.assert_array_equal(np.asarray(vp_got), np.asarray(vp_ref))
+    # pad row's pages (incl. page 0, which its zeroed table row points
+    # at) keep the sentinel fill where no valid token landed
+    assert float(kp_got[:, 6:].min()) == -7.0
