@@ -155,6 +155,11 @@ _TUNED_BLOCKS = {
     (2048, 4096): (512, 4096),      # wo / wq       449 GB/s
     (2048, 28672): (2048, 1024),    # gate+up fused 601 GB/s
     (7168, 4096): (512, 4096),      # w_down        532 GB/s
+    (2048, 129024): (2048, 2048),   # padded lm_head 619 GB/s (vs 551 at
+                                    # the table default; measured with a
+                                    # 4x-stacked payload — a single-layer
+                                    # stack is loop-INVARIANT in the tune
+                                    # scan and XLA hoists the call)
 }
 
 

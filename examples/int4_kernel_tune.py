@@ -42,13 +42,14 @@ def log(msg):
 
 
 # 8B decode shapes: (name, L, K, N) — the r5 FUSED shapes (qkv N=6144,
-# gate+up N=28672) plus wo / w_down. lm_head (N=128256) is excluded:
-# its N tiles only at 256, outside this sweep's block set.
+# gate+up N=28672) plus wo / w_down and the vocab-PADDED lm_head
+# (128256 → 129024 = 2048·63; the raw width tiles only at bn=256).
 SHAPES = [
     ("qkv_fused", 32, 4096, 6144),
     ("wo", 32, 4096, 4096),
     ("gate_up_fused", 32, 4096, 28672),
     ("w_down", 32, 14336, 4096),
+    ("lm_head_padded", 1, 4096, 129024),
 ]
 BKS = (2048, 1024, 512)
 BNS = (4096, 2048, 1024)
