@@ -6,10 +6,11 @@ Diagnostics go to stderr.
 
 Default rung (BASELINE.md ladder rung 3-4, VERDICT r1 item 1): steady-state
 decode throughput of an **8B-class Llama-shaped model, packed-int4 weights
-(the fastest measured config — stacked Mosaic kernel, r4), continuous
-engine with paged KV** on one v5e chip — random-init (weights'
-values don't change the FLOP/byte counts; zero-egress environment has no
-checkpoint on disk). Alongside tok/s it reports the HBM roofline:
+(the fastest measured config — stacked Mosaic kernel with fused
+qkv/gate+up payloads, per-shape tuned blocks, and a vocab-padded
+lm_head; 5,458 tok/s r5), continuous engine with paged KV at bs128** on
+one v5e chip — random-init (weights' values don't change the FLOP/byte
+counts; zero-egress environment has no checkpoint on disk). Alongside tok/s it reports the HBM roofline:
 ``hbm_util`` = achieved bytes/s ÷ the chip's ~819 GB/s — decode is
 bandwidth-bound, so this is the honest "how much headroom is left" number.
 
@@ -21,9 +22,9 @@ divided by the mock's simulated 20 responses/s — a vacuous ratio, retired.)
 Env knobs:
     BENCH_MODEL    spec name (default llama3-8b; gpt2 = round-1 rung)
     BENCH_QUANT    4 = packed int4 (default for 8B-class since r4 — the
-                   fastest measured config, 4,254 tok/s via the stacked
-                   Mosaic kernel), 1/8 = int8, 0 = full precision
-                   (default for small models)
+                   fastest measured config, 5,458 tok/s at bs128 via the
+                   stacked Mosaic kernel + r5 fusions), 1/8 = int8,
+                   0 = full precision (default for small models)
     BENCH_ENGINE   continuous (default) | static | serving
     BENCH_BATCH    decode slots (default 128 for the 8B int4 continuous
                    flagship — the bs that int4's freed HBM affords, 5,453
