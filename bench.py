@@ -31,6 +31,11 @@ Env knobs:
                    tok/s measured; 64 otherwise)
     BENCH_PROMPT / BENCH_NEW_TOKENS   lengths (default 128 / 128)
     BENCH_KV_DTYPE paged-KV dtype (continuous; default bfloat16)
+    BENCH_ATTN     attention impl: xla (default) | pallas |
+                   pallas-decode (fused flash-decode kernel: paged prefix
+                   + side window in one pallas_call per layer,
+                   ops/flash_decode.py) | pallas-decode-fw (same + fresh-KV
+                   side writeback in the kernel epilogue)
     BENCH_DECODE_MODE  window | inline (default: window for 8B-class,
                    inline for small-KV models — the measured crossover)
     BENCH_ENGINE=speculative: draft = the target's own first

@@ -94,7 +94,11 @@ class EngineConfig:
     kv_dtype: str = "bfloat16"
     decode_steps_per_call: int = 8     # tokens generated per jit dispatch (lax.scan)
     use_paged_kv: bool = False
-    attention_impl: str = "auto"       # "auto" | "xla" | "pallas"
+    attention_impl: str = "auto"       # "auto" | "xla" | "pallas" |
+    # "pallas-decode" (fused flash-decode kernel: paged prefix + side
+    # window in ONE pallas_call per layer, ops/flash_decode.py) |
+    # "pallas-decode-fw" (same + fresh-KV side writeback in the kernel
+    # epilogue); append "_interpret" to either for CPU interpret mode
     decode_mode: str = "window"        # continuous engine: "window" freezes
                                        # the page pools per chunk, gathers
                                        # the live prefix ONCE into a dense

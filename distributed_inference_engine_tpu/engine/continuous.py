@@ -189,7 +189,10 @@ class ContinuousEngine:
         if impl == "auto":
             # XLA gather-attention wins at serving shapes on real hardware
             # (see ops.paged_attention.paged_attention for the numbers);
-            # "pallas" stays available as an explicit config choice
+            # "pallas" stays available as an explicit config choice, and
+            # "pallas-decode"/"pallas-decode-fw" select the fused
+            # flash-decode kernel (ops/flash_decode.py) on the
+            # side-window decode path
             impl = "xla"
         self.attn_impl = impl
         self.prefix_cache = bool(cfg.prefix_cache)
@@ -1474,7 +1477,8 @@ class ContinuousEngine:
                 elif (caps is not None
                         and state.produced < req.max_new_tokens
                         and state.stop_cut < 0
-                        and int(lengths_row[slot]) >= caps[slot]):
+                        and int(lengths_row[slot]) >= caps[slot]
+                        and caps[slot] < self.max_seq_len):
                     # the device stopped at the chunk's CAPACITY grant
                     # (ensure_capacity landed exactly on a page boundary,
                     # e.g. prompt+chunk = one page), not at a budget or
@@ -1483,6 +1487,10 @@ class ContinuousEngine:
                     # pages (or retires it for real if the pool is dry).
                     # Without this, a request whose prompt+chunk filled
                     # page 1 finished early as "length" with budget left.
+                    # A slot already granted max_seq_len is NOT paused —
+                    # no revive can grow it past the model cap, so it
+                    # falls through to the "length" finish below instead
+                    # of burning one more dispatch to learn the same.
                     revived.append(slot)
                 else:
                     # _finish re-trims and upgrades the reason to "stop"

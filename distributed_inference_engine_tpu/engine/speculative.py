@@ -208,12 +208,11 @@ class SpeculativeEngine:
                 lambda x: jax.device_put(x, self._rep_sharding), draft_params)
         from ..ops.quant import fuse_block_weights, prepare_params
 
-        # shared engine-init prep (sharded int4 -> "cp", then fusion);
-        # the draft fuses too — its serial propose loop is launch-
-        # overhead-bound, exactly what fewer launches helps. Fusion's
-        # tp guard is per-member sharding, so the always-replicated
-        # draft fuses even when a sharded target flipped the global
-        # mode to "cp"
+        # shared engine-init prep (sharded int4 -> per-tensor "cp"
+        # stamps, then fusion); the draft fuses too — its serial propose
+        # loop is launch-overhead-bound, exactly what fewer launches
+        # helps. The "cp" stamp rides the TARGET's tensors only, so the
+        # always-replicated draft keeps the default single-device kernel
         self.params = prepare_params(params)
         self.draft_params = fuse_block_weights(draft_params)
         self._rng = jax.random.key(seed + 1)

@@ -632,6 +632,10 @@ def forward_decode_window(
     length; those fall back to ``forward_decode_paged``).
     """
     from ..ops.attention import merge_attention, window_decode_attention
+    from ..ops.flash_decode import (
+        flash_decode_attention,
+        flash_decode_attention_fw_pallas,
+    )
     from ..ops.paged_attention import paged_attention
 
     b = tokens.shape[0]
@@ -647,6 +651,14 @@ def forward_decode_window(
     impl = attn_impl
     if impl == "auto":
         impl = "xla"     # measured fastest (see ops.paged_attention)
+    # fused flash-decode (ops.flash_decode): ONE kernel per layer streams
+    # the paged prefix, folds the side window into the same online-softmax
+    # accumulators, and skips the separate window/merge fusions. The "-fw"
+    # variant additionally lands the fresh K/V row in its epilogue instead
+    # of the [B, W] one-hot rewrite below.
+    fd = impl.startswith("pallas-decode")
+    fd_fw = impl.startswith("pallas-decode-fw")
+    fd_interpret = impl.endswith("_interpret")
     if impl.startswith("pallas"):
         # stacked view: the kernel indexes pages as layer·N + table[i, p],
         # so the scan hands it the WHOLE pool — slicing a layer out per
@@ -665,25 +677,45 @@ def forward_decode_window(
         q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
         sk = lax.dynamic_index_in_dim(side_k, l, 0, keepdims=False)
         sv = lax.dynamic_index_in_dim(side_v, l, 0, keepdims=False)
-        sk = jnp.where(onehot[:, :, None, None], k[:, 0][:, None], sk)
-        sv = jnp.where(onehot[:, :, None, None], v[:, 0][:, None], sv)
-        side_k = lax.dynamic_update_index_in_dim(side_k, sk, l, 0)
-        side_v = lax.dynamic_update_index_in_dim(side_v, sv, l, 0)
-        if impl.startswith("pallas"):
-            prefix = paged_attention(
+        if fd_fw:
+            # fresh K/V goes in as its own operand; the kernel attends to
+            # it and DMAs it into the aliased side row in its epilogue
+            attn, sk, sv = flash_decode_attention_fw_pallas(
                 q[:, 0], kp_flat, vp_flat, page_table, start_lengths,
-                n_kv_heads=spec.n_kv_heads, impl=impl, with_stats=True,
+                sk, sv, k, v, idx, active.astype(jnp.int32),
+                n_kv_heads=spec.n_kv_heads, interpret=fd_interpret,
                 layer=l, n_pages_per_layer=n_pages,
             )
         else:
-            kp_l = lax.dynamic_index_in_dim(k_pages, l, 0, keepdims=False)
-            vp_l = lax.dynamic_index_in_dim(v_pages, l, 0, keepdims=False)
-            prefix = paged_attention(
-                q[:, 0], kp_l, vp_l, page_table, start_lengths,
-                n_kv_heads=spec.n_kv_heads, impl=impl, with_stats=True,
-            )
-        window_part = window_decode_attention(q[:, 0], sk, sv, n_side)
-        attn = merge_attention([prefix, window_part], dtype=q.dtype)
+            sk = jnp.where(onehot[:, :, None, None], k[:, 0][:, None], sk)
+            sv = jnp.where(onehot[:, :, None, None], v[:, 0][:, None], sv)
+            if fd:
+                attn = flash_decode_attention(
+                    q[:, 0], kp_flat, vp_flat, page_table, start_lengths,
+                    sk, sv, n_side, n_kv_heads=spec.n_kv_heads, impl=impl,
+                    layer=l, n_pages_per_layer=n_pages,
+                )
+            else:
+                if impl.startswith("pallas"):
+                    prefix = paged_attention(
+                        q[:, 0], kp_flat, vp_flat, page_table, start_lengths,
+                        n_kv_heads=spec.n_kv_heads, impl=impl,
+                        with_stats=True, layer=l, n_pages_per_layer=n_pages,
+                    )
+                else:
+                    kp_l = lax.dynamic_index_in_dim(k_pages, l, 0,
+                                                    keepdims=False)
+                    vp_l = lax.dynamic_index_in_dim(v_pages, l, 0,
+                                                    keepdims=False)
+                    prefix = paged_attention(
+                        q[:, 0], kp_l, vp_l, page_table, start_lengths,
+                        n_kv_heads=spec.n_kv_heads, impl=impl,
+                        with_stats=True,
+                    )
+                window_part = window_decode_attention(q[:, 0], sk, sv, n_side)
+                attn = merge_attention([prefix, window_part], dtype=q.dtype)
+        side_k = lax.dynamic_update_index_in_dim(side_k, sk, l, 0)
+        side_v = lax.dynamic_update_index_in_dim(side_v, sv, l, 0)
         x = x + _out_proj(spec, blk, attn[:, None])
         h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
         m, _ = _mlp(spec, blk, h2)
@@ -722,6 +754,11 @@ def forward_decode_paged(
     """
     from ..ops.paged_attention import paged_attention
 
+    if attn_impl.startswith("pallas-decode"):
+        # the fused flash-decode kernel serves only the side-window path
+        # (forward_decode_window); per-step paged decode falls back to the
+        # measured-fastest XLA gather attention
+        attn_impl = "xla"
     b = tokens.shape[0]
     n_pages = k_pages.shape[1]
     page_size = k_pages.shape[2]

@@ -45,8 +45,10 @@ share one reduction factor — the split-half layout then shards
 COHERENTLY for row-parallel weights (each device's packed rows hold the
 lo nibbles of exactly its xlo shard's columns and the hi nibbles of its
 xhi shard's) and trivially for column-parallel, with no repacking and
-no gather. Engines flip to "cp" automatically when int4 params land
-sharded (``ops.quant.select_kernel_mode_for_params``).
+no gather. Engines stamp "cp" onto their OWN int4 tensors when params
+land sharded (``ops.quant.resolve_kernel_modes`` — per-engine scope;
+the module-level mode below is only the process default / env
+override).
 """
 
 from __future__ import annotations
@@ -59,6 +61,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# renamed across jax versions (TPUCompilerParams -> CompilerParams)
+_CompilerParams = getattr(pltpu, "TPUCompilerParams", None) or \
+    pltpu.CompilerParams
 
 # kernel dispatch mode (read at TRACE time):
 #   auto      — use the kernel on a single-device TPU process (the bench /
@@ -92,17 +98,27 @@ def _block_of(size: int, candidates: Tuple[int, ...]) -> Optional[int]:
     return None
 
 
-def _mode_engaged() -> bool:
+def _tensor_mode(w) -> str:
+    """Effective kernel mode for one weight: the per-tensor stamp
+    (``ops.quant.resolve_kernel_modes`` — tp engines mark their OWN int4
+    tensors "cp" instead of flipping process state) or the module
+    default."""
+    return getattr(w, "kernel_mode", "") or _MODE
+
+
+def _mode_engaged(mode: str = "") -> bool:
     """Mode/backend half of kernel eligibility (shared by the per-layer
     and stacked predicates): "on"/"cp" always, "auto" only on a
     single-device TPU process. ("cp" wraps the kernel in a
     custom_partitioning op so GSPMD can partition it — without that a
     pallas_call is opaque and tp-sharded weights would force a gather;
-    engines flip to "cp" when their int4 params land multi-device.)"""
-    if _MODE == "off":
+    engines stamp "cp" onto their int4 params when placement lands them
+    multi-device.)"""
+    mode = mode or _MODE
+    if mode == "off":
         return False
-    return _MODE in ("on", "cp") or (jax.default_backend() == "tpu"
-                                     and len(jax.devices()) == 1)
+    return mode in ("on", "cp") or (jax.default_backend() == "tpu"
+                                    and len(jax.devices()) == 1)
 
 
 def pattern_fits(pattern: str, x, k2: int) -> bool:
@@ -124,7 +140,7 @@ def kernel_wants(pattern: str, x, w) -> bool:
     it, the weight is an unstacked ``[K/2, N]`` payload contracted on its
     packed axis, and the shapes tile cleanly (K/2 and N divisible by the
     block candidates). Everything else falls back to the XLA path."""
-    if not _mode_engaged():
+    if not _mode_engaged(_tensor_mode(w)):
         return False
     if w.q.ndim != 2 or w.pack_axis % w.q.ndim != 0:
         return False                    # payload must be packed on axis 0
@@ -188,7 +204,7 @@ def int4_einsum_kernel(pattern: str, x, w):
     lead = x.shape[:-1]
     xm = x.reshape(-1, x.shape[-1])
     interpret = jax.default_backend() != "tpu"
-    if _MODE == "cp":
+    if _tensor_mode(w) == "cp":
         y = _cp_stacked(interpret)(xm[:, :k2], xm[:, k2:], w.q[None],
                                    w.s.astype(jnp.float32).reshape(1, 1, n),
                                    jnp.zeros((1,), jnp.int32))
@@ -211,7 +227,8 @@ def stacked_kernel_wants(w) -> bool:
     ~230 ms of s8 dynamic-slice fusions per 930 ms of chunks)."""
     from .quant import QuantizedTensor
 
-    if not isinstance(w, QuantizedTensor) or not _mode_engaged():
+    if not isinstance(w, QuantizedTensor) \
+            or not _mode_engaged(_tensor_mode(w)):
         return False
     if w.bits != 4 or w.q.ndim != 3 or w.pack_axis % (w.q.ndim - 1) != 0:
         return False                # per-layer slice must pack on axis 0
@@ -294,7 +311,7 @@ def _int4_matmul_stacked(x, packed, scale, layer, *, interpret: bool = False,
         _kernel_stacked,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((mp, n), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             # the int32 nibble-widening temporaries ([bk, bn] lo+hi) top
             # 16 MB at the prefill tile (bm=128, bn=2048) — past the
@@ -323,7 +340,7 @@ def int4_einsum_kernel_stacked(pattern: str, x, w, layer):
     lead = x.shape[:-1]
     xm = x.reshape(-1, x.shape[-1])
     interpret = jax.default_backend() != "tpu"
-    if _MODE == "cp":
+    if _tensor_mode(w) == "cp":
         y = _cp_stacked(interpret)(xm[:, :k2], xm[:, k2:], w.q,
                                    w.s.astype(jnp.float32),
                                    jnp.atleast_1d(layer).astype(jnp.int32))

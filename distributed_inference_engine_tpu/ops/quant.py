@@ -69,14 +69,27 @@ class QuantizedTensor:
                                      # the stacked [L, ...] layer axis off
                                      # (lax.scan, truncated_draft) leaves
                                      # it pointing at the same dim
+    kernel_mode: str = ""            # per-TENSOR int4 kernel mode stamped
+                                     # by resolve_kernel_modes ("" =
+                                     # inherit the process default): a tp
+                                     # engine's "cp" selection rides its
+                                     # own params instead of a process
+                                     # global, so co-resident engines on
+                                     # different meshes don't
+                                     # cross-contaminate
 
     def tree_flatten(self):
-        return (self.q, self.s), (self.bits, self.pack_axis)
+        return (self.q, self.s), (self.bits, self.pack_axis,
+                                  self.kernel_mode)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        bits, pack_axis = aux if isinstance(aux, tuple) else (8, -1)
-        return cls(*children, bits=bits, pack_axis=pack_axis)
+        if not isinstance(aux, tuple):
+            aux = (8, -1)
+        bits, pack_axis = aux[0], aux[1]
+        mode = aux[2] if len(aux) > 2 else ""
+        return cls(*children, bits=bits, pack_axis=pack_axis,
+                   kernel_mode=mode)
 
     @property
     def shape(self):
@@ -258,38 +271,53 @@ FUSED_GROUPS: Dict[str, Tuple[str, ...]] = {
 _FUSE_BLOCKERS = {"w_qkv": ("bq", "bk", "bv"), "w_gate_up": ("b_up",)}
 
 
-def select_kernel_mode_for_params(params: Dict[str, Any]) -> None:
-    """Flip the int4 kernel to its GSPMD-partitionable "cp" mode when any
-    int4 payload in ``params`` has landed SHARDED across devices (tp
-    serving) — the direct pallas path is opaque to GSPMD and would force
-    a weight gather. Fully-replicated multi-device placements (dp-only
-    meshes, a speculative draft replicated next to a sharded target) do
-    NOT flip: the direct kernel + fusion path is both valid and faster
-    there. Only upgrades from "auto"; explicit "on"/"off"/"cp" settings
-    are respected. Called by the engines after param placement."""
-    from .int4_matmul import kernel_mode, set_kernel_mode
+def resolve_kernel_modes(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp the int4 kernel mode ON the params (per-engine scope): when
+    any int4 payload in ``params`` has landed SHARDED across devices (tp
+    serving), every int4 tensor in the tree gets ``kernel_mode="cp"`` —
+    the GSPMD-partitionable path; the direct pallas call is opaque to
+    GSPMD and would force a weight gather. Fully-replicated multi-device
+    placements (dp-only meshes, a speculative draft replicated next to a
+    sharded target) are NOT stamped: the direct kernel + fusion path is
+    both valid and faster there.
+
+    Pure — returns a new tree, touches no process state. (Through r5 this
+    flipped the module-global mode in ``ops.int4_matmul`` as an engine-
+    construction side effect, so a tp engine silently switched every
+    OTHER engine in the process onto the cp path.) An explicit global
+    setting ("on"/"off"/"cp" via env or ``set_kernel_mode``) is
+    respected: nothing is stamped, the global applies."""
+    from .int4_matmul import kernel_mode
 
     if kernel_mode() != "auto":
-        return
-    for leaf in jax.tree_util.tree_leaves(
-            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
-        if (isinstance(leaf, QuantizedTensor) and leaf.bits == 4
-                and getattr(leaf.q, "sharding", None) is not None
-                and len(leaf.q.sharding.device_set) > 1
-                and not leaf.q.sharding.is_fully_replicated):
-            set_kernel_mode("cp")
-            return
+        return params
+
+    def _is_qt(x):
+        return isinstance(x, QuantizedTensor)
+
+    leaves = jax.tree_util.tree_leaves(params, is_leaf=_is_qt)
+    sharded = any(
+        isinstance(leaf, QuantizedTensor) and leaf.bits == 4
+        and getattr(leaf.q, "sharding", None) is not None
+        and len(leaf.q.sharding.device_set) > 1
+        and not leaf.q.sharding.is_fully_replicated
+        for leaf in leaves)
+    if not sharded:
+        return params
+    return jax.tree_util.tree_map(
+        lambda x: dataclasses.replace(x, kernel_mode="cp")
+        if _is_qt(x) and x.bits == 4 else x,
+        params, is_leaf=_is_qt)
 
 
 def prepare_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Engine-init param preparation, one entry point for every engine:
-    (1) flip the int4 kernel to "cp" if placement left int4 payloads
-    sharded across devices; (2) fuse qkv / gate+up payloads when the
-    kernel is engaged — skipped per-member for tp-sharded payloads (the
-    fused output axis would shard across head groups), kept for
-    replicated trees."""
-    select_kernel_mode_for_params(params)
-    return fuse_block_weights(params)
+    (1) stamp the int4 tensors with kernel mode "cp" if placement left
+    payloads sharded across devices (per-engine scope, no global state);
+    (2) fuse qkv / gate+up payloads when the kernel is engaged — skipped
+    per-member for tp-sharded payloads (the fused output axis would
+    shard across head groups), kept for replicated trees."""
+    return fuse_block_weights(resolve_kernel_modes(params))
 
 
 def fuse_block_weights(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -334,7 +362,8 @@ def fuse_block_weights(params: Dict[str, Any]) -> Dict[str, Any]:
         fused = QuantizedTensor(
             q=jnp.concatenate([w.q for w in ws], axis=-1),
             s=jnp.concatenate([w.s for w in ws], axis=-1),
-            bits=4, pack_axis=ws[0].pack_axis)
+            bits=4, pack_axis=ws[0].pack_axis,
+            kernel_mode=ws[0].kernel_mode)
         if not stacked_kernel_wants(fused):
             continue                          # summed N must still tile
         for m in members:

@@ -19,6 +19,8 @@ import ctypes
 import functools
 import json
 import pathlib
+import re
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..native import load_library
@@ -127,6 +129,48 @@ class _NativeBPE:
             self._handle = None
 
 
+# ---------------------------------------------------- pre-tokenizer parse
+
+
+def _extract_pretok_pattern(pre) -> Optional[str]:
+    """Pull the split regex out of tokenizer.json's ``pre_tokenizer``.
+
+    HF serializes GPT-2 as ``ByteLevel`` (its implicit regex = the
+    ``_PRETOK`` default below) and Llama-3/Qwen2 as a ``Sequence`` of a
+    ``Split`` (carrying the model's own regex — different contraction
+    casing, 1-3 digit number chunks) + a non-splitting ``ByteLevel``.
+    Returns the explicit regex to use, or None for the GPT-2 default.
+    Unrecognized structures warn and fall back to the default — the
+    pre-r6 behavior (always GPT-2), now loud instead of silent.
+    """
+    if pre is None:
+        return None
+    t = pre.get("type") if isinstance(pre, dict) else None
+    if t == "ByteLevel":
+        if pre.get("use_regex", True):
+            return None                  # GPT-2's own split
+        return None                      # splitting handled elsewhere
+    if t == "Split":
+        pat = pre.get("pattern", {})
+        rx = pat.get("Regex") if isinstance(pat, dict) else None
+        if rx:
+            return rx
+    elif t == "Sequence":
+        for sub in pre.get("pretokenizers", []):
+            rx = _extract_pretok_pattern(sub)
+            if rx:
+                return rx
+        # all-ByteLevel sequences are the GPT-2 shape
+        if all(isinstance(s, dict) and s.get("type") == "ByteLevel"
+               for s in pre.get("pretokenizers", [])):
+            return None
+    warnings.warn(
+        f"tokenizer.json pre_tokenizer {t!r} not recognized — falling "
+        "back to the GPT-2 split regex; ids may diverge from the HF "
+        "tokenizer for numeric/uppercase text", stacklevel=3)
+    return None
+
+
 # ---------------------------------------------------------------- BPE
 
 
@@ -135,9 +179,19 @@ class BPETokenizer:
 
     def __init__(self, vocab: Dict[str, int],
                  merges: List[Tuple[str, str]],
-                 use_native: bool = True) -> None:
+                 use_native: bool = True,
+                 pretok_pattern: Optional[str] = None,
+                 special_tokens: Optional[Dict[str, int]] = None) -> None:
         self.vocab = vocab
+        # specials (HF added_tokens) encode ATOMICALLY to their own id and
+        # bypass pre-tokenization/BPE entirely; on a content collision with
+        # model.vocab the added id wins for encoding (HF semantics) but
+        # both ids decode to the content
+        self.special_tokens = dict(special_tokens or {})
+        self._pretok_pattern = pretok_pattern
         self.inv_vocab = {v: k for k, v in vocab.items()}
+        for content, tid in self.special_tokens.items():
+            self.inv_vocab[tid] = content
         b2u = _bytes_to_unicode()
         self._byte_to_unit = {b: vocab[u] for b, u in b2u.items() if u in vocab}
         self._u2b = {u: b for b, u in b2u.items()}
@@ -197,13 +251,22 @@ class BPETokenizer:
             raise ValueError(
                 f"tokenizer.json vocab covers only {covered}/256 byte "
                 "units — a SentencePiece-style BPE, not byte-level")
+        specials: Dict[str, int] = {}
         for t in d.get("added_tokens", []):
-            vocab.setdefault(t["content"], t["id"])
+            content, tid = t["content"], t["id"]
+            # specials encode through the atomic pre-split (see encode),
+            # so a content collision with model.vocab keeps the model id
+            # in the merge vocab while the added id still encodes/decodes
+            specials[content] = tid
+            vocab.setdefault(content, tid)
         merges: List[Tuple[str, str]] = []
         for m in model.get("merges", []):
             a, b = m.split(" ", 1) if isinstance(m, str) else m
             merges.append((a, b))
-        return cls(vocab, merges, **kw)
+        return cls(vocab, merges,
+                   pretok_pattern=_extract_pretok_pattern(
+                       d.get("pre_tokenizer")),
+                   special_tokens=specials, **kw)
 
     # GPT-2's pre-tokenization pattern: merges only apply WITHIN these
     # chunks (contractions / space-prefixed words / numbers / punctuation /
@@ -216,7 +279,17 @@ class BPETokenizer:
     def _pretok_re(self):
         import regex
 
-        return regex.compile(self._PRETOK)
+        return regex.compile(self._pretok_pattern or self._PRETOK)
+
+    @functools.cached_property
+    def _special_re(self):
+        """Alternation over added-token strings, longest first, so e.g.
+        <|eot_id|> encodes atomically instead of byte-splitting (engine
+        eos/stop matching never fires on the split ids)."""
+        if not self.special_tokens:
+            return None
+        pats = sorted(self.special_tokens, key=len, reverse=True)
+        return re.compile("|".join(re.escape(s) for s in pats))
 
     @property
     def vocab_size(self) -> int:
@@ -227,6 +300,19 @@ class BPETokenizer:
         return self._native is not None
 
     def encode(self, text: str) -> List[int]:
+        sre = self._special_re
+        if sre is None:
+            return self._encode_ordinary(text)
+        out: List[int] = []
+        pos = 0
+        for m in sre.finditer(text):
+            out.extend(self._encode_ordinary(text[pos:m.start()]))
+            out.append(self.special_tokens[m.group()])
+            pos = m.end()
+        out.extend(self._encode_ordinary(text[pos:]))
+        return out
+
+    def _encode_ordinary(self, text: str) -> List[int]:
         out: List[int] = []
         for chunk in self._pretok_re.findall(text):
             ids = [self._byte_to_unit[b] for b in chunk.encode("utf-8")

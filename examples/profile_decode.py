@@ -30,6 +30,8 @@ def classify(name: str, d_ff: int = 14336, vocab: int = 128256) -> str:
     n = name.lower()
     if "int4_matmul" in n or ("tpu_custom_call" in n and "int4" in n):
         return "int4 kernel (weights)"
+    if "flash_decode" in n:
+        return "flash-decode kernel (attn + KV read)"
     if "tpu_custom_call" in n or "pallas" in n:
         return "pallas kernel (other)"
     # the int4 lm_head is vocab-PADDED (ops.quant._pad_vocab) — match

@@ -42,6 +42,8 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run the test in an event loop")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -119,6 +119,25 @@ def test_max_seq_len_capacity_finish():
     assert cont.get_metrics()["kv"]["pages_used"] == 0
 
 
+def test_max_seq_len_finish_skips_pause_revive():
+    """A slot that stops exactly at max_seq_len with budget left must be
+    finished as "length" in the same harvest — NOT revived for one more
+    dispatch that the next capacity loop retires anyway. The revive path
+    exists for page-boundary pauses the pool can still grow past;
+    max_seq_len it cannot, and the old behavior both inflated
+    ``capacity_finishes`` and paid an extra active-flag dispatch pair."""
+    cfg = _cfg(max_slots=1, num_pages=32, page_size=16, max_seq_len=32)
+    cont = ContinuousEngine(SPEC, config=cfg, seed=0)
+    req = GenerationRequest(prompt=list(range(1, 29)), max_new_tokens=50,
+                            temperature=0.0, request_id="cap")
+    res = cont.generate([req])[0]
+    assert res.finish_reason == "length"
+    assert 1 <= len(res.tokens) <= 5
+    m = cont.get_metrics()
+    assert m["capacity_finishes"] == 0       # old path: 1 (revive+retire)
+    assert m["kv"]["pages_used"] == 0
+
+
 def test_metrics_shape():
     cont = ContinuousEngine(SPEC, config=_cfg(), seed=0)
     m = cont.get_metrics()

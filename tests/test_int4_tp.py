@@ -33,8 +33,9 @@ from distributed_inference_engine_tpu.parallel.sharding import ModelShardings
 
 @pytest.fixture(autouse=True)
 def reset_mode():
-    """select_kernel_mode_for_params flips process-global state; keep
-    tests hermetic."""
+    """The auto "cp" selection is per-tensor now (resolve_kernel_modes
+    stamps the engine's own params), but the module default is still
+    settable explicitly / via env; keep tests hermetic."""
     yield
     set_kernel_mode("auto")
 
@@ -86,8 +87,9 @@ def test_cp_matmul_column_and_row_sharded_match_reference():
 
 def test_tp_int4_engine_matches_xla_path():
     """End-to-end: a tp=2 Engine over int4 params auto-selects mode "cp"
-    (the kernel partitions instead of gathering) and decodes the same
-    greedy tokens as the unsharded XLA int4 path."""
+    (stamped on ITS OWN tensors — the kernel partitions instead of
+    gathering) and decodes the same greedy tokens as the unsharded XLA
+    int4 path."""
     spec = _spec()
     params = quant.random_quantized_params(spec, jax.random.key(0), bits=4)
     cfg = EngineConfig(max_slots=2, max_seq_len=64, prefill_buckets=[16],
@@ -110,8 +112,9 @@ def test_tp_int4_engine_matches_xla_path():
     with mesh:
         tp = Engine(spec, params=params, config=cfg, seed=0,
                     shard_fn=shardings.shard_fn())
-        assert kernel_mode() == "cp"          # flipped by param placement
+        assert kernel_mode() == "auto"        # process state untouched
         wq = tp.params["blocks"]["wq"]
+        assert wq.kernel_mode == "cp"         # stamped by param placement
         assert len(wq.q.sharding.device_set) == 2
         out_tp = tp.generate(reqs())
     for a, b in zip(out_base, out_tp):
@@ -136,6 +139,44 @@ def test_tp_int4_untileable_local_falls_back_not_fails():
     with mesh:
         tp = Engine(spec, params=params, config=cfg, seed=0,
                     shard_fn=shardings.shard_fn())
-        assert kernel_mode() == "cp"
+        assert tp.params["blocks"]["wq"].kernel_mode == "cp"
         out_tp = tp.generate(req)
     assert out_base[0].tokens == out_tp[0].tokens
+
+
+def test_two_engines_different_meshes_do_not_cross_contaminate():
+    """A tp engine's "cp" selection must not leak into a single-device
+    engine built afterwards in the same process (the old implementation
+    flipped module state as an Engine-construction side effect, so the
+    SECOND engine inherited the first one's kernel mode — its decode
+    then dispatched the multi-device cp wrapper on replicated params)."""
+    spec = _spec()
+    params = quant.random_quantized_params(spec, jax.random.key(2), bits=4)
+    cfg = EngineConfig(max_slots=1, max_seq_len=64, prefill_buckets=[16],
+                       kv_dtype="float32", decode_steps_per_call=4)
+    req = [GenerationRequest(prompt=[2, 4, 6, 8, 10], max_new_tokens=5,
+                             temperature=0.0, request_id="x")]
+
+    # reference tokens from a clean process state
+    out_ref = Engine(spec, params=params, config=cfg, seed=0).generate(req)
+
+    mesh = make_mesh(MeshConfig(dp=1, sp=1, tp=2), jax.devices()[:2])
+    shardings = ModelShardings.build(spec, mesh)
+    with mesh:
+        tp = Engine(spec, params=params, config=cfg, seed=0,
+                    shard_fn=shardings.shard_fn())
+    assert tp.params["blocks"]["wq"].kernel_mode == "cp"
+
+    # second engine, unsharded: its tensors stay unstamped, the process
+    # default is still "auto", and its decode takes the single-device
+    # path — under the old global flip this generate() dispatched cp
+    solo = Engine(spec, params=params, config=cfg, seed=0)
+    assert kernel_mode() == "auto"
+    modes = {
+        leaf.kernel_mode
+        for leaf in jax.tree.leaves(
+            solo.params, is_leaf=lambda x: isinstance(x, quant.QuantizedTensor))
+        if isinstance(leaf, quant.QuantizedTensor)
+    }
+    assert modes == {""}, modes
+    assert solo.generate(req)[0].tokens == out_ref[0].tokens

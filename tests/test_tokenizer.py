@@ -82,3 +82,108 @@ def test_py_core_tie_break_is_leftmost():
     # two applications of the same rank: leftmost merges first
     ranks = {(0, 1): (0, 9)}
     assert _py_bpe_encode([0, 1, 0, 1], ranks) == [9, 9]
+
+
+# ------------------------------------------------- tokenizer.json parsing
+
+# Llama-3's split regex as serialized in its tokenizer.json: digits chunk
+# in groups of AT MOST 3 (vs GPT-2's unbounded ` ?\p{N}+`)
+_LLAMA3_SPLIT = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+"
+                 r"|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+"
+                 r"|\s+(?!\S)|\s+")
+
+
+def _full_byte_vocab():
+    """All 256 byte units (passes the byte-level coverage check)."""
+    return {u: i for i, u in enumerate(_bytes_to_unicode().values())}
+
+
+def _write_tokenizer_json(tmp_path, *, pre_tokenizer=None, added_tokens=(),
+                          extra_vocab=(), merges=()):
+    vocab = _full_byte_vocab()
+    for unit in extra_vocab:
+        vocab[unit] = len(vocab)
+    d = {
+        "model": {"type": "BPE", "vocab": vocab,
+                  "merges": [list(m) for m in merges]},
+        "added_tokens": list(added_tokens),
+    }
+    if pre_tokenizer is not None:
+        d["pre_tokenizer"] = pre_tokenizer
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(d))
+    return str(p)
+
+
+def test_llama3_pre_tokenizer_digit_chunking(tmp_path):
+    """A Llama-3-style pre_tokenizer (Sequence[Split(Regex), ByteLevel])
+    must be parsed and USED: its 1-3 digit chunks forbid the 3+4 merge
+    that GPT-2's unbounded number chunk would apply to "12345"."""
+    b2u = _bytes_to_unicode()
+    u3, u4 = b2u[ord("3")], b2u[ord("4")]
+    pre = {"type": "Sequence", "pretokenizers": [
+        {"type": "Split", "pattern": {"Regex": _LLAMA3_SPLIT},
+         "behavior": "Isolated", "invert": False},
+        {"type": "ByteLevel", "add_prefix_space": False, "use_regex": False},
+    ]}
+    path = _write_tokenizer_json(tmp_path, pre_tokenizer=pre,
+                                 extra_vocab=[u3 + u4], merges=[(u3, u4)])
+    t = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    gpt2 = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    gpt2._pretok_pattern = None          # what the hard-coded regex did
+    assert t.decode(t.encode("12345")) == "12345"
+    # GPT-2 chunking merges 3+4 across the 123|45 boundary; Llama-3 can't
+    assert len(gpt2.encode("12345")) == 4
+    assert len(t.encode("12345")) == 5
+    # uppercase contraction: (?i:'s) matches "'S" under Llama-3 only
+    assert t.decode(t.encode("IT'S")) == "IT'S"
+
+
+def test_gpt2_pre_tokenizer_no_warning(tmp_path):
+    import warnings as w
+
+    pre = {"type": "ByteLevel", "add_prefix_space": False}
+    path = _write_tokenizer_json(tmp_path, pre_tokenizer=pre)
+    with w.catch_warnings():
+        w.simplefilter("error")
+        t = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    assert t._pretok_pattern is None
+
+
+def test_unrecognized_pre_tokenizer_warns(tmp_path):
+    path = _write_tokenizer_json(
+        tmp_path, pre_tokenizer={"type": "Whitespace"})
+    with pytest.warns(UserWarning, match="pre_tokenizer"):
+        t = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    assert t._pretok_pattern is None     # falls back, loudly
+
+
+def test_added_tokens_encode_atomically(tmp_path):
+    """<|eot_id|> must encode to ITS id (chat-template prompts previously
+    byte-split specials, so engine eos/stop matching never fired)."""
+    eot = {"content": "<|eot_id|>", "id": 1000, "special": True}
+    hdr = {"content": "<|start_header_id|>", "id": 1001, "special": True}
+    path = _write_tokenizer_json(tmp_path, added_tokens=[eot, hdr])
+    t = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    ids = t.encode("hi<|eot_id|>")
+    assert ids[-1] == 1000 and 1000 not in ids[:-1]
+    assert t.encode("<|start_header_id|>user<|eot_id|>")[0] == 1001
+    assert t.decode(t.encode("a<|eot_id|>b")) == "a<|eot_id|>b"
+    # plain text is untouched by the special pre-split
+    assert t.encode("no specials here") == t._encode_ordinary(
+        "no specials here")
+
+
+def test_added_token_id_collision(tmp_path):
+    """An added token whose content already sits in model.vocab under a
+    DIFFERENT id: the added id must win for encoding (HF semantics) and
+    both ids must decode (the old ``setdefault`` silently dropped it)."""
+    b2u = _bytes_to_unicode()
+    a_unit = b2u[ord("a")]
+    model_id = _full_byte_vocab()[a_unit]
+    path = _write_tokenizer_json(
+        tmp_path, added_tokens=[{"content": "a", "id": 777}])
+    t = BPETokenizer.from_tokenizer_json(path, use_native=False)
+    assert t.encode("bab")[1] == 777
+    assert t.decode([777]) == "a"
+    assert t.decode([model_id]) == "a"   # merge-table id still decodes
