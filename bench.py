@@ -38,6 +38,12 @@ Env knobs:
                    side writeback in the kernel epilogue)
     BENCH_DECODE_MODE  window | inline (default: window for 8B-class,
                    inline for small-KV models — the measured crossover)
+    BENCH_KV_OFFLOAD   1 = host-RAM KV tier (continuous engine;
+                   engine/kv_offload.py): evicted prefix pages offload to
+                   host instead of dropping, admission prefetches them
+                   back, pool exhaustion swaps decode victims instead of
+                   finishing them; BENCH_KV_OFFLOAD_BYTES caps the host
+                   store (default 1 GiB)
     BENCH_ENGINE=speculative: draft = the target's own first
                    BENCH_DRAFT_LAYERS layers (default 8), k=BENCH_SPEC_K
                    (default 4) — deterministic acceptance from shared
@@ -228,6 +234,13 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
         cfg.prefill_chunk = raw
         chunk = max(cfg.page_size, raw // cfg.page_size * cfg.page_size)
         cfg.prefill_buckets = sorted({chunk, PROMPT_LEN})
+    if os.environ.get("BENCH_KV_OFFLOAD", "") not in ("", "0"):
+        # host-RAM KV tier: evicted prefix pages offload instead of
+        # dropping, admission prefetches host hits back, pool exhaustion
+        # swaps decode victims out and resumes them (engine/kv_offload.py)
+        cfg.kv_offload = True
+        cfg.kv_offload_bytes = int(
+            os.environ.get("BENCH_KV_OFFLOAD_BYTES", str(1 << 30)))
     return ContinuousEngine(spec, params=params, config=cfg)
 
 

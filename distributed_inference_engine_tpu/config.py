@@ -112,6 +112,17 @@ class EngineConfig:
                                        # class: 10673 vs 7169). Sliding-
                                        # window specs always run inline.
     prefix_cache: bool = True          # reuse full KV pages across shared prompt prefixes
+    kv_offload: bool = False           # host-RAM second tier for the paged
+                                       # cache (engine/kv_offload.py):
+                                       # evicted prefix pages offload
+                                       # device->host instead of dropping,
+                                       # admission prefetches host hits
+                                       # back, and pool exhaustion swaps a
+                                       # decode victim to host + resumes it
+                                       # later instead of finishing it with
+                                       # reason="length"
+    kv_offload_bytes: int = 1 << 30    # host-tier byte budget (LRU store
+                                       # + swap reservations share it)
     prefill_chunk: int = 0             # continuous engine: prompts longer than
                                        # this prefill in chunks interleaved with
                                        # decode (0 = whole-prompt prefill);
@@ -280,6 +291,77 @@ def config_from_dict(d: Dict[str, Any]) -> Config:
     return cfg
 
 
+def _toml_scalar(raw: str):
+    raw = raw.strip()
+    if raw.startswith('"') and raw.endswith('"'):
+        return raw[1:-1]
+    if raw.startswith("'") and raw.endswith("'"):
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        return [_toml_scalar(x) for x in inner.split(",")] if inner else []
+    try:
+        return int(raw)
+    except ValueError:
+        return float(raw)
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Fallback TOML reader for the config subset this repo uses —
+    ``[table]``, ``[nested.table]``, ``[[array of tables]]``, and scalar /
+    flat-list values. tomllib is stdlib only from 3.11 and tomli may not be
+    installed; config files must still load on 3.10."""
+    root: dict = {}
+    cur = root
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[["):
+            parts = line[2:].split("]]", 1)[0].strip().split(".")
+            parent = root
+            for k in parts[:-1]:
+                parent = parent.setdefault(k, {})
+            cur = {}
+            parent.setdefault(parts[-1], []).append(cur)
+        elif line.startswith("["):
+            parts = line[1:].split("]", 1)[0].strip().split(".")
+            # [models.metadata] after [[models]] nests into the LAST
+            # element of the models array
+            parent = root
+            for k in parts[:-1]:
+                node = parent.get(k)
+                parent = node[-1] if isinstance(node, list) else \
+                    parent.setdefault(k, {})
+            node = parent.get(parts[-1])
+            if isinstance(node, list):
+                cur = node[-1]
+            else:
+                cur = parent.setdefault(parts[-1], {})
+        else:
+            key, _, raw = line.partition("=")
+            # strip a trailing comment (the subset has no '#' inside strings
+            # except quoted ones, which _toml_scalar handles before we cut)
+            raw = raw.strip()
+            if not (raw.startswith('"') or raw.startswith("'")):
+                raw = raw.split("#", 1)[0]
+            cur[key.strip()] = _toml_scalar(raw)
+    return root
+
+
+def _loads_toml(text: str) -> dict:
+    try:
+        import tomllib
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            return _parse_toml_minimal(text)
+    return tomllib.loads(text)
+
+
 def load_config(path: str) -> Config:
     """Load a Config from JSON, TOML, or YAML by extension."""
     p = pathlib.Path(path)
@@ -287,9 +369,7 @@ def load_config(path: str) -> Config:
     if p.suffix in (".json",):
         data = json.loads(text)
     elif p.suffix in (".toml",):
-        import tomllib
-
-        data = tomllib.loads(text)
+        data = _loads_toml(text)
     elif p.suffix in (".yaml", ".yml"):
         import yaml
 

@@ -58,7 +58,7 @@ from ..ops.sampling import (
 )
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
-from .paged_kv import PagedKVCache
+from .paged_kv import PagedKVCache, page_chain_hashes
 from .types import (
     EngineOverloadedError,
     GenerationRequest,
@@ -109,6 +109,25 @@ class _PrefillProgress:
         self.done = 0                   # tokens already prefilled (page-aligned)
         self.on_tokens = on_tokens
         self.t_submit = t_submit
+
+
+class _SwapRecord:
+    """A decode sequence preempted to the host tier: its ``_Slot`` state
+    plus the exact device KV it held. Invariant carried across the swap:
+    the KV covers exactly ``kv_len`` positions and ``state.tokens[-1]`` is
+    the latest sampled token, NOT yet written to KV — precisely the shape
+    ``_install`` expects, so resume is an install, never a prefill."""
+
+    __slots__ = ("state", "kv_len", "k_pages", "v_pages", "nbytes")
+
+    def __init__(self, state: "_Slot", kv_len: int,
+                 k_pages: List[np.ndarray], v_pages: List[np.ndarray],
+                 nbytes: int) -> None:
+        self.state = state
+        self.kv_len = kv_len
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.nbytes = nbytes
 
 
 class ContinuousEngine:
@@ -176,10 +195,19 @@ class ContinuousEngine:
 
         self.max_slots = cfg.max_slots
         max_seq = min(cfg.max_seq_len, spec.max_seq_len)
+        # host-RAM second tier (engine/kv_offload.py): evictions offload,
+        # admissions prefetch, pool exhaustion swaps instead of finishing
+        self._offload = None
+        if getattr(cfg, "kv_offload", False):
+            from .kv_offload import HostKVOffload
+
+            self._offload = HostKVOffload(
+                max_bytes=int(getattr(cfg, "kv_offload_bytes", 1 << 30)))
         self.kv = PagedKVCache(
             spec, max_slots=cfg.max_slots, page_size=cfg.page_size,
             num_pages=cfg.num_pages, max_seq_len=max_seq,
             dtype=cfg.kv_dtype, sharding=kv_sharding,
+            offload=self._offload,
         )
         self.prefill_buckets = sorted(
             {b for b in cfg.prefill_buckets if b < max_seq} | {max_seq}
@@ -228,6 +256,9 @@ class ContinuousEngine:
         )
         self._slots: Dict[int, _Slot] = {}
         self._finished: List[GenerationResult] = []
+        # swap-based preemption: victims parked on the host tier, resumed
+        # FIFO when pages free up (_SwapRecord list; offload tier only)
+        self._swapped: Deque["_SwapRecord"] = collections.deque()
 
         # device-side per-slot state [max_slots]
         n = cfg.max_slots
@@ -586,6 +617,9 @@ class ContinuousEngine:
         self._rejected_full = 0        # submits refused: queue at cap
         self._shed_deadline = 0        # queued requests shed past deadline
         self._capacity_finishes = 0
+        self._swap_outs = 0         # decode victims parked on the host tier
+        self._swap_resumes = 0      # parked victims back in a slot (no prefill)
+        self._swap_fallbacks = 0    # host budget refused a swap -> "length"
         self._steps = 0
         self._prefill_calls = 0     # batched-admission dispatches
         self._occupancy_sum = 0     # Σ live slots per step (occupancy)
@@ -758,6 +792,7 @@ class ContinuousEngine:
             vs = np.zeros_like(ks)
             ks[:, 0, :tail] = handoff.k[:, off:]
             vs[:, 0, :tail] = handoff.v[:, off:]
+            self.kv.sync_tiers()       # flush host-tier traffic pre-write
             kp, vp = self._write_pages(
                 self.kv.k_pages, self.kv.v_pages,
                 jnp.asarray(ks), jnp.asarray(vs),
@@ -918,6 +953,10 @@ class ContinuousEngine:
         their suffix programs individually (per-hit context shapes).
         """
         self._shed_expired()
+        if self._swapped:
+            # swap-preempted sequences are OLDER than anything waiting:
+            # they resume first, before new admissions drain the pool
+            self._resume_swapped()
         admitted = self._admit_prefilled()
         if self._should_hold_admissions():
             return admitted
@@ -1038,6 +1077,7 @@ class ContinuousEngine:
                                   jnp.asarray(top_p), jnp.asarray(min_p))
         self._rng, k0 = jax.random.split(self._rng)
         seq_dev = jnp.asarray(seq_lens)
+        self.kv.sync_tiers()           # flush host-tier traffic pre-write
         if self._prefill_pages is not None:
             # fused path: per-layer KV scatters into the donated pools
             # inside the prefill scan (pad rows' seq_len 0 drops every
@@ -1154,6 +1194,9 @@ class ContinuousEngine:
                                   jnp.asarray(top_p), jnp.asarray(min_p))
         lens_dev = jnp.asarray(suffix_lens)
         ctx_dev = jnp.asarray(n_ctx)
+        # flush host-tier traffic: staged uploads (host prefix hits) must
+        # land before the suffix program reads its context pages
+        self.kv.sync_tiers()
         first_dev, ks, vs = self._prefill_suffix(
             self.params, jnp.asarray(tokens), lens_dev, ctx_dev,
             jnp.asarray(phys), self.kv.k_pages, self.kv.v_pages,
@@ -1315,6 +1358,133 @@ class ContinuousEngine:
             decode_s=time.perf_counter() - state.first_token_at,
         ))
 
+    # ------------------------------------------------- swap-based preempt
+
+    def _try_swap_out(self, slot: int) -> bool:
+        """Preempt a decode slot that cannot grow: park its exact KV on
+        the host tier and queue it for a later resume, instead of the
+        discard-only ``finish_reason="length"``. Returns False when the
+        slot should finish normally (budget/stop already reached, or at
+        the model cap, or the host tier refuses the bytes)."""
+        if self._offload is None:
+            return False
+        state = self._slots[slot]
+        req = state.request
+        cur = int(self._lengths_host[slot])
+        if cur >= self.max_seq_len:
+            return False                 # model cap: "length" is correct
+        if state.first_pending:
+            # the deferred first token lives only in the device firsts
+            # buffer, which the slot's successor will overwrite — rescue
+            # it now (same direct read as _finish; swap-outs are rare)
+            state.first_pending = False
+            fp = np.asarray(self._firsts_dev[:, slot])
+            state.tokens.insert(0, int(fp[0]))
+            state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
+            state.first_token_at = time.perf_counter()
+            self.ttft_stats.add(state.first_token_at - state.admitted_at)
+            state.produced = len(state.tokens)
+            state.stop_cut = find_stop_cut(state.tokens, req)
+        if state.produced >= req.max_new_tokens or state.stop_cut >= 0:
+            return False                 # already done — plain finish
+        n_pages = self.kv._pages_for(cur)
+        nbytes = n_pages * self.kv.page_bytes
+        if not self._offload.reserve_swap(nbytes):
+            self._swap_fallbacks += 1
+            return False
+        pages = self.kv._slot_pages[slot][:n_pages]
+        ks, vs = self.kv.read_pages(pages)   # one batched device→host read
+        self._swapped.append(_SwapRecord(state, cur, ks, vs, nbytes))
+        self._slots.pop(slot)
+        self.kv.free_slot(slot)
+        self._swap_outs += 1
+        return True
+
+    def _resume_swapped(self) -> int:
+        """Re-admit parked sequences (FIFO) once a slot AND one decode
+        chunk's worth of page headroom are free — the headroom gate keeps
+        a resume from being immediately re-preempted. Resume is an
+        install + staged page upload: NO prefill program runs (the
+        acceptance invariant ``prefill_calls`` counts)."""
+        resumed = 0
+        n_steps = self.config.decode_steps_per_call
+        while self._swapped:
+            rec = self._swapped[0]
+            need = self.kv._pages_for(
+                min(rec.kv_len + n_steps, self.max_seq_len))
+            if not self.kv._free_slots or self.kv.available_pages < need:
+                if not self._slots and not self._prefilling:
+                    # idle engine that still can't host the record (pool
+                    # smaller than the sequence): nothing will ever free
+                    # more — finish it rather than spin forever
+                    self._swapped.popleft()
+                    self._finish_swapped(rec, "length")
+                    continue
+                break
+            slot = self.kv.alloc_slot(rec.kv_len)
+            if slot is None:
+                break
+            self._swapped.popleft()
+            pages = self.kv._slot_pages[slot]
+            self.kv.stage_uploads(pages[: len(rec.k_pages)],
+                                  rec.k_pages, rec.v_pages)
+            self._offload.release_swap(rec.nbytes)
+            state = rec.state
+            state.slot_id = slot
+            self._slots[slot] = state
+            req = state.request
+            # device install: KV holds exactly kv_len positions and the
+            # last sampled token is tokens[-1] — the same (lengths, last)
+            # contract a fresh admission meets, so the ordinary install
+            # program applies. TTFT was stamped long ago; no re-stamp.
+            self._install_device([{
+                "slot": slot, "prompt_len": rec.kv_len,
+                "first": state.tokens[-1], "max_new": req.max_new_tokens,
+                "eos": req.eos_id, "temp": req.temperature,
+                "top_k": req.top_k, "top_p": req.top_p,
+                "min_p": req.min_p}])
+            # _install hard-codes produced=1 (true for admissions);
+            # restore the real count — rare path, eager set acceptable
+            self._produced = self._produced.at[slot].set(state.produced)
+            self._swap_resumes += 1
+            resumed += 1
+        return resumed
+
+    def _finish_swapped(self, rec: _SwapRecord, reason: str) -> None:
+        """Resolve a parked sequence without resuming it (engine-idle
+        fallback and abort paths); releases its host reservation."""
+        self._offload.release_swap(rec.nbytes)
+        state = rec.state
+        req = state.request
+        toks, stopped = trim_at_stops(state.tokens, req)
+        if stopped:
+            reason = "stop"
+        self._total_generated += len(toks)
+        self._finished.append(GenerationResult(
+            request_id=req.request_id,
+            tokens=toks,
+            finish_reason=reason,
+            prompt_tokens=state.prompt_len,
+            logprobs=state.logprobs[: len(toks)],
+            ttft_s=state.first_token_at - state.admitted_at,
+            decode_s=time.perf_counter() - state.first_token_at,
+        ))
+
+    def prefetch_probe(self, request: GenerationRequest) -> int:
+        """Async-prefetch hook for the serving layer: on enqueue, hash the
+        request's (clamped) prompt and start host→device uploads for any
+        leading pages resident only in the host tier — the PCIe copy then
+        overlaps queue wait and batch formation instead of sitting on the
+        admission critical path. Safe no-op without the offload tier."""
+        if self._offload is None or not self.prefix_cache:
+            return 0
+        prompt = request.prompt[-(self.max_seq_len - 1):]
+        matchable = (len(prompt) - 1) // self.kv.page_size
+        if matchable < 1:
+            return 0
+        hashes = page_chain_hashes(prompt, matchable, self.kv.page_size)
+        return self.kv.prefetch_chain(hashes)
+
     # --------------------------------------------------------------- step
 
     def step(self) -> int:
@@ -1334,7 +1504,7 @@ class ContinuousEngine:
             # buffer and _Slot references here instead of holding them
             # across an idle period
             self._pending = None
-            return len(self._prefilling)
+            return len(self._prefilling) + len(self._swapped)
         self._steps += 1
         self._occupancy_sum += len(self._slots)   # batch occupancy metric
 
@@ -1347,18 +1517,39 @@ class ContinuousEngine:
         ahead = 2 * n_steps if self._defer else n_steps
         retired: List[int] = []
         for slot in list(self._slots):
+            state = self._slots.get(slot)
+            if state is None:
+                continue                 # finished by a mid-loop flush below
             cur = int(lengths_np[slot])
             cap_tok = self.kv.ensure_capacity(slot, cur + ahead)
+            if (cap_tok <= cur and self._offload is not None
+                    and self._pending is not None):
+                # before preempting under defer_sync, process the deferred
+                # chunk: a swap decision needs CURRENT host state (lengths,
+                # produced, stops), and the flush's finishes may free
+                # enough pages to avoid preempting at all. Earlier slots'
+                # grants already covered the in-flight chunk (ahead =
+                # 2*n_steps), so flushing mid-loop is safe for them.
+                prev, self._pending = self._pending, None
+                self._process_packed(*prev)
+                if self._slots.get(slot) is not state:
+                    continue             # the flush finished this slot
+                cur = int(lengths_np[slot])
+                cap_tok = self.kv.ensure_capacity(slot, cur + ahead)
             if cap_tok <= cur:
-                self._capacity_finishes += 1
-                retired.append(slot)
-                self._finish(slot, "length")
+                if self._try_swap_out(slot):
+                    retired.append(slot)       # deactivate, no finish
+                else:
+                    self._capacity_finishes += 1
+                    retired.append(slot)
+                    self._finish(slot, "length")
             else:
                 n_steps = min(n_steps, cap_tok - cur)
         self._deactivate_many(retired)
 
         if not self._slots or n_steps <= 0:
-            return len(self._slots) + len(self._prefilling)
+            return (len(self._slots) + len(self._prefilling)
+                    + len(self._swapped))
 
         t0 = time.perf_counter()
         cap_list = [min(self.kv.slot_capacity(s), self.max_seq_len)
@@ -1381,6 +1572,10 @@ class ContinuousEngine:
         sampling = SamplingParams(self._temps, self._top_k, self._top_p,
                                   self._min_p)
         self._rng, kc = jax.random.split(self._rng)
+        # flush host-tier traffic (evict-offload reads queued by the
+        # capacity loop's reclaims; swap-in uploads staged by resume)
+        # before the chunk writes the pools
+        self.kv.sync_tiers()
         carry, packed = self._decode_chunk(
             self.params, self.kv.k_pages, self.kv.v_pages,
             self._lengths, self._last, self._active, self._produced,
@@ -1401,7 +1596,8 @@ class ContinuousEngine:
                 self._process_packed(*prev)
         else:
             self._process_packed(packed, n_steps, snapshot, t0, cap_list)
-        return len(self._slots) + len(self._prefilling)
+        return (len(self._slots) + len(self._prefilling)
+                + len(self._swapped))
 
     def _process_packed(self, packed, n_steps: int,
                         snapshot: Dict[int, _Slot], t0: float,
@@ -1562,10 +1758,13 @@ class ContinuousEngine:
         return their pages to the pool. Recovery hook for the pump when a
         decode step fails irrecoverably."""
         n = (len(self._waiting) + len(self._waiting_prefilled)
-             + len(self._slots) + len(self._prefilling))
+             + len(self._slots) + len(self._prefilling)
+             + len(self._swapped))
         self._pending = None            # drop an unprocessed deferred chunk
         self._waiting.clear()
         self._waiting_prefilled.clear()
+        while self._swapped:            # release their host reservations
+            self._offload.release_swap(self._swapped.popleft().nbytes)
         for slot in list(self._slots):
             self._slots.pop(slot)
             self.kv.free_slot(slot)
@@ -1583,8 +1782,9 @@ class ContinuousEngine:
     def n_live(self) -> int:
         # mid-chunked-prefill sequences hold slots/pages and need further
         # step() calls: callers gating their pump loop on n_live (e.g.
-        # serving/pump.py) must see them or the engine stalls mid-prompt
-        return len(self._slots) + len(self._prefilling)
+        # serving/pump.py) must see them or the engine stalls mid-prompt;
+        # swap-preempted sequences likewise — they resume via step()
+        return len(self._slots) + len(self._prefilling) + len(self._swapped)
 
     # ------------------------------------------------------------- warmup
 
@@ -1640,6 +1840,24 @@ class ContinuousEngine:
     # ------------------------------------------------------------ metrics
 
     def get_metrics(self) -> Dict[str, Any]:
+        offload_m: Dict[str, Any] = {}
+        if self._offload is not None:
+            # hidden-latency ESTIMATE (not a measurement): prefill seconds
+            # the host-tier hits avoided, priced at this engine's own mean
+            # prefill rate — host_hit_tokens × (prefill wall / prompt
+            # tokens prefilled). Honest as a ratio of work displaced; the
+            # truly hidden share also depends on how much of the upload
+            # overlapped batch formation.
+            rate = (self.prefill_stats.total / self._total_prompt_tokens
+                    if self._total_prompt_tokens else 0.0)
+            offload_m = {
+                "swap_outs": self._swap_outs,
+                "swap_resumes": self._swap_resumes,
+                "swap_fallback_finishes": self._swap_fallbacks,
+                "swapped_parked": len(self._swapped),
+                "prefetch_hidden_latency_est_s": (
+                    self.kv._host_hit_tokens * rate),
+            }
         return {
             "total_requests": self._total_requests,
             "total_prompt_tokens": self._total_prompt_tokens,
@@ -1666,5 +1884,6 @@ class ContinuousEngine:
             "prefill": self.prefill_stats.snapshot(),
             "decode_chunk": self.chunk_stats.snapshot(),
             "kv": self.kv.get_stats(),
+            **({"kv_offload": offload_m} if offload_m else {}),
             "attn_impl": self.attn_impl,
         }

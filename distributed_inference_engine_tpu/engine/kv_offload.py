@@ -1,0 +1,168 @@
+"""Host-RAM second tier for the paged KV cache.
+
+The device prefix cache is terminal without this module: when
+``PagedKVCache._take_free`` runs dry it reclaims cached prefix pages and
+their KV is simply gone — every later request sharing that prefix pays a
+full prefill recompute. Here evicted pages drop one level instead of off a
+cliff: their contents move device→host into a byte-budgeted LRU keyed by
+the SAME page-chain hashes as the device index, and admission falls
+through to this tier, uploading hits host→device so prefill runs only the
+truly-uncached suffix (PRESERVE / async-KV-prefetch: the upload overlaps
+batch formation, so its latency hides behind work the engine does
+anyway).
+
+The store also backs swap-based preemption: when the pool exhausts
+mid-decode, the continuous engine parks a victim slot's pages here under a
+separate reservation (``reserve_swap``) and later resumes the sequence by
+re-uploading them — no recompute, no "length" finish. Swap bytes and LRU
+bytes share one ``max_bytes`` budget; swap reservations are hard (never
+evicted), the LRU yields to them.
+
+Pure host-side bookkeeping: the only JAX calls are ``jax.device_put`` for
+staged uploads. Transfers INTO the store are batched by the cache's
+``sync_tiers`` (one ``device_get`` per flush, not per page).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class _Entry:
+    __slots__ = ("k", "v", "nbytes", "k_dev", "v_dev")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray) -> None:
+        self.k = k
+        self.v = v
+        self.nbytes = k.nbytes + v.nbytes
+        # staged async uploads (jax.device_put results); populated by
+        # start_upload, consumed by get
+        self.k_dev = None
+        self.v_dev = None
+
+
+class HostKVOffload:
+    """Byte-budgeted host LRU of KV pages, keyed by page-chain hash."""
+
+    def __init__(self, max_bytes: int = 1 << 30) -> None:
+        self.max_bytes = int(max_bytes)
+        self._entries: "collections.OrderedDict[bytes, _Entry]" = (
+            collections.OrderedDict()
+        )
+        self._lru_bytes = 0
+        self._swap_bytes = 0        # hard reservations (preempted slots)
+        self._offloaded_pages = 0
+        self._offloaded_bytes = 0
+        self._hit_pages = 0
+        self._hit_bytes = 0
+        self._staged_pages = 0
+        self._evicted_pages = 0
+        self._rejected_pages = 0
+
+    # --------------------------------------------------------------- LRU
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def probe(self, key: bytes) -> bool:
+        """Presence check WITHOUT recency touch (advisory probes must not
+        reorder the LRU under the real consumers)."""
+        return key in self._entries
+
+    def admit(self, key: bytes) -> bool:
+        """Should the cache bother offloading this page? False when the
+        tier is disabled (budget 0) or the key is already stored — the
+        stored copy was written at registration time and page contents are
+        immutable once registered, so a re-offload is pure waste."""
+        return self.max_bytes > 0 and key not in self._entries
+
+    def put(self, key: bytes, k: np.ndarray, v: np.ndarray) -> bool:
+        """Insert one page's KV (host arrays, ``[L, page_size, fused]``).
+        Evicts oldest entries to fit the budget; returns False when the
+        page can't fit even after evicting everything (swap reservations
+        are never evicted)."""
+        if key in self._entries:
+            return True
+        entry = _Entry(k, v)
+        budget = self.max_bytes - self._swap_bytes
+        while self._entries and self._lru_bytes + entry.nbytes > budget:
+            self._evict_oldest()
+        if self._lru_bytes + entry.nbytes > budget:
+            self._rejected_pages += 1
+            return False
+        self._entries[key] = entry
+        self._lru_bytes += entry.nbytes
+        self._offloaded_pages += 1
+        self._offloaded_bytes += entry.nbytes
+        return True
+
+    def get(self, key: bytes) -> Optional[Tuple[object, object]]:
+        """Fetch a page's (k, v) for upload, touching recency. Returns the
+        staged device arrays when ``start_upload`` already ran (the async
+        prefetch case) — otherwise the host arrays; either feeds the same
+        scatter."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        self._entries.move_to_end(key)
+        self._hit_pages += 1
+        self._hit_bytes += entry.nbytes
+        if entry.k_dev is not None:
+            return entry.k_dev, entry.v_dev
+        return entry.k, entry.v
+
+    def start_upload(self, key: bytes) -> bool:
+        """Begin an async host→device copy of the entry (non-blocking:
+        ``device_put`` returns immediately; the transfer overlaps whatever
+        the engine does until admission consumes it via ``get``)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return False
+        if entry.k_dev is None:
+            entry.k_dev = jax.device_put(entry.k)
+            entry.v_dev = jax.device_put(entry.v)
+            self._staged_pages += 1
+        return True
+
+    def _evict_oldest(self) -> None:
+        _, entry = self._entries.popitem(last=False)
+        self._lru_bytes -= entry.nbytes
+        self._evicted_pages += 1
+
+    # -------------------------------------------------- swap reservations
+
+    def reserve_swap(self, nbytes: int) -> bool:
+        """Reserve budget for a preempted slot's pages. Evicts LRU entries
+        to make room; False when the reservation cannot fit (the engine
+        then falls back to the old finish_reason="length" behavior)."""
+        while (self._entries
+               and self._lru_bytes + self._swap_bytes + nbytes > self.max_bytes):
+            self._evict_oldest()
+        if self._lru_bytes + self._swap_bytes + nbytes > self.max_bytes:
+            return False
+        self._swap_bytes += nbytes
+        return True
+
+    def release_swap(self, nbytes: int) -> None:
+        self._swap_bytes = max(0, self._swap_bytes - nbytes)
+
+    # ------------------------------------------------------------- stats
+
+    def get_stats(self) -> Dict[str, float]:
+        return {
+            "host_max_bytes": self.max_bytes,
+            "host_lru_bytes": self._lru_bytes,
+            "host_swap_bytes": self._swap_bytes,
+            "host_pages": len(self._entries),
+            "offloaded_pages": self._offloaded_pages,
+            "offloaded_bytes": self._offloaded_bytes,
+            "host_hit_pages": self._hit_pages,
+            "host_hit_bytes": self._hit_bytes,
+            "host_staged_pages": self._staged_pages,
+            "host_evicted_pages": self._evicted_pages,
+            "host_rejected_pages": self._rejected_pages,
+        }

@@ -28,6 +28,7 @@ boundaries crossed mid-chunk already have physical pages behind them.
 from __future__ import annotations
 
 import collections
+import functools
 import hashlib
 from typing import Dict, List, Optional, Tuple
 
@@ -36,6 +37,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.base import ModelSpec
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_pages(k_pages, v_pages, ids, k_vals, v_vals):
+    """Write whole pages back into the (donated) pools: the host-tier
+    upload's one dispatch. ``ids`` may repeat (pow2 padding duplicates the
+    last entry) — duplicate scatter writes carry identical values, so the
+    undefined write order is harmless."""
+    return k_pages.at[:, ids].set(k_vals), v_pages.at[:, ids].set(v_vals)
 
 
 class OutOfPagesError(RuntimeError):
@@ -72,6 +82,7 @@ class PagedKVCache:
         max_seq_len: Optional[int] = None,
         dtype: Optional[str] = None,
         sharding=None,   # NamedSharding over [L, N, P, fused] (tp serving)
+        offload=None,    # HostKVOffload: host-RAM second tier (optional)
     ) -> None:
         fused = spec.n_kv_heads * spec.head_dim
         if fused % 128:
@@ -127,6 +138,19 @@ class PagedKVCache:
         self._prefix_queries = 0
         self._prefix_reclaimed = 0
 
+        # ---- host tier (engine/kv_offload.py). Transfers are QUEUED here
+        # and flushed by sync_tiers() — one batched device_get / one scatter
+        # dispatch per flush, called by the engine immediately before any
+        # program that writes the pools (so queued reads see pre-write
+        # contents and queued writes land before being read).
+        self.offload = offload
+        self._pending_offload: List[Tuple[bytes, int]] = []   # (key, page)
+        self._pending_upload: Dict[int, Tuple[object, object]] = {}
+        self._host_hit_pages = 0
+        self._host_hit_tokens = 0
+        self._upload_pages = 0
+        self._upload_bytes = 0
+
     # ------------------------------------------------------- page sourcing
 
     @property
@@ -150,6 +174,18 @@ class PagedKVCache:
             key = self._page_key.pop(page)
             self._prefix_index.pop(key, None)
             self._prefix_reclaimed += 1
+            if self.offload is not None:
+                if page in self._pending_upload:
+                    # host-hit landing page reclaimed before its upload
+                    # flushed: the DEVICE copy is stale (never written) and
+                    # the store still holds the authoritative bytes — drop
+                    # the upload, never offload the stale contents
+                    self._pending_upload.pop(page)
+                elif self.offload.admit(key):
+                    # contents stay intact until the next pool-writing
+                    # dispatch, and sync_tiers flushes this queue before
+                    # any such dispatch — deferred read is safe
+                    self._pending_offload.append((key, page))
             out.append(page)
         for p in out:
             self._page_ref[p] = 1
@@ -269,13 +305,37 @@ class PagedKVCache:
         count a prefix-aware handoff may omit. Advisory: pages can be
         reclaimed between probe and admission; ``alloc_slot_prefix`` at
         admission is authoritative and a shortfall surfaces as the typed
-        ``stale_prefix`` outcome (the sender re-ships the full KV)."""
+        ``stale_prefix`` outcome (the sender re-ships the full KV).
+
+        Falls through to the host tier: a page evicted from the device
+        index but still resident in host RAM counts as cached — admission
+        will upload it rather than recompute it."""
         n = 0
         for h in hashes:
-            if h not in self._prefix_index:
+            if h in self._prefix_index:
+                n += 1
+            elif self.offload is not None and self.offload.probe(h):
+                n += 1
+            else:
                 break
-            n += 1
         return n
+
+    def prefetch_chain(self, hashes: List[bytes]) -> int:
+        """Async-prefetch hook (serving pump, on enqueue): for each leading
+        chain hash resident ONLY in the host tier, start its host→device
+        copy now, so by the time admission runs the transfer is already in
+        flight and the upload scatter consumes staged device arrays instead
+        of blocking on PCIe. Returns how many uploads were started."""
+        if self.offload is None:
+            return 0
+        started = 0
+        for h in hashes:
+            if h in self._prefix_index:
+                continue
+            if not self.offload.start_upload(h):
+                break
+            started += 1
+        return started
 
     def first_page_hash(self, tokens,
                         registerable: bool = False) -> Optional[bytes]:
@@ -317,6 +377,21 @@ class PagedKVCache:
             if page is None:
                 break
             shared.append(page)
+        # continue the chain through the host tier: hashes past the device
+        # match whose pages still live in host RAM get fresh device pages
+        # with a staged upload instead of a recompute
+        host_hits: List[Tuple[bytes, object, object]] = []
+        if self.offload is not None:
+            for h in hashes[len(shared):]:
+                if h in self._prefix_index:
+                    # chain re-enters the device index mid-stream (the key
+                    # was re-registered after its offload): staging a host
+                    # upload here would double-index h — stop the chain
+                    break
+                got = self.offload.get(h)
+                if got is None:
+                    break
+                host_hits.append((h, got[0], got[1]))
         # PIN the shared pages BEFORE sourcing fresh ones: a ref-0 cached
         # page sits in _reclaimable, and an unpinned _take_free under pool
         # pressure could reclaim one of THESE pages as this slot's own
@@ -331,9 +406,20 @@ class PagedKVCache:
                 self._unref(p)
             return None
         slot = self._install_slot_pages(shared + fresh, n_tokens)
-        n_cached = len(shared) * self.page_size
+        # host-hit pages land in the slot's leading fresh pages; index them
+        # NOW (pre-flush) so same-round siblings pin and share them — the
+        # upload scatter lands before any program reads the pool
+        for i, (h, k_arr, v_arr) in enumerate(host_hits):
+            page = fresh[i]
+            self._pending_upload[page] = (k_arr, v_arr)
+            self._prefix_index[h] = page
+            self._page_key[page] = h
+            self._upload_pages += 1
+        n_cached = (len(shared) + len(host_hits)) * self.page_size
         self._prefix_hits_pages += len(shared)
-        self._prefix_hits_tokens += n_cached
+        self._prefix_hits_tokens += len(shared) * self.page_size
+        self._host_hit_pages += len(host_hits)
+        self._host_hit_tokens += len(host_hits) * self.page_size
         return slot, n_cached
 
     def register_prefix(self, slot: int, tokens) -> int:
@@ -377,6 +463,79 @@ class PagedKVCache:
         """Adopt page pools returned by a jitted (donating) decode step."""
         self.k_pages, self.v_pages = new_k, new_v
 
+    # ------------------------------------------------- host-tier transfers
+
+    @property
+    def page_bytes(self) -> int:
+        """Host bytes one page's K+V occupy (all layers)."""
+        l, _, p, fused = self.k_pages.shape
+        return 2 * l * p * fused * self.k_pages.dtype.itemsize
+
+    def _gather_pages(self, pages: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """One batched device→host read of whole pages → numpy
+        ``[L, n, page_size, fused]`` pair. The id vector pads to a pow2
+        bucket (repeating the last page) so the gather compiles
+        O(log max-batch) programs, not one per count."""
+        n = len(pages)
+        bucket = 1 << max(0, n - 1).bit_length()
+        ids = np.asarray(pages + [pages[-1]] * (bucket - n), np.int32)
+        ids = jnp.asarray(ids)
+        k = np.asarray(jax.device_get(self.k_pages[:, ids]))[:, :n]
+        v = np.asarray(jax.device_get(self.v_pages[:, ids]))[:, :n]
+        return k, v
+
+    def read_pages(self, pages: List[int]):
+        """Batched read of physical pages as per-page contiguous host
+        arrays — the swap-out path's device→host copy."""
+        k, v = self._gather_pages(list(pages))
+        return ([np.ascontiguousarray(k[:, i]) for i in range(len(pages))],
+                [np.ascontiguousarray(v[:, i]) for i in range(len(pages))])
+
+    def stage_uploads(self, pages: List[int], ks, vs) -> None:
+        """Queue host→device page writes (swap-in resume). Target pages
+        must be refcounted to the caller's slot; applied at the next
+        ``sync_tiers``."""
+        for p, k_arr, v_arr in zip(pages, ks, vs):
+            self._pending_upload[int(p)] = (k_arr, v_arr)
+
+    def sync_tiers(self) -> None:
+        """Flush queued host↔device page traffic. The engine calls this
+        immediately before dispatching ANY program that writes the pools
+        (admission prefill, suffix prefill, handoff page write, decode
+        chunk) — the single ordering point of the two-tier design:
+
+        1. pending offloads first — a device→host read of reclaimed pages,
+           whose contents are intact exactly until the next pool write;
+        2. THEN staged uploads — one donating scatter; an upload's target
+           page may itself be queued for offload (reclaimed and reissued
+           in the same round), so reads must precede writes.
+        """
+        if self.offload is None:
+            return
+        if self._pending_offload:
+            pend, self._pending_offload = self._pending_offload, []
+            k, v = self._gather_pages([p for _, p in pend])
+            for i, (key, _page) in enumerate(pend):
+                self.offload.put(key,
+                                 np.ascontiguousarray(k[:, i]),
+                                 np.ascontiguousarray(v[:, i]))
+        if self._pending_upload:
+            items = list(self._pending_upload.items())
+            self._pending_upload.clear()
+            n = len(items)
+            self._upload_bytes += sum(
+                int(k_arr.nbytes) + int(v_arr.nbytes) for _, (k_arr, v_arr)
+                in items)
+            bucket = 1 << max(0, n - 1).bit_length()
+            items.extend([items[-1]] * (bucket - n))  # identical dup writes
+            ids = jnp.asarray(np.asarray([p for p, _ in items], np.int32))
+            k_vals = jnp.stack(
+                [jnp.asarray(kv[0], self.dtype) for _, kv in items], axis=1)
+            v_vals = jnp.stack(
+                [jnp.asarray(kv[1], self.dtype) for _, kv in items], axis=1)
+            self.k_pages, self.v_pages = _scatter_pages(
+                self.k_pages, self.v_pages, ids, k_vals, v_vals)
+
     # ------------------------------------------------------------ stats
 
     @property
@@ -393,6 +552,18 @@ class PagedKVCache:
     def get_stats(self) -> Dict[str, float]:
         bytes_total = 2 * self.k_pages.size * self.k_pages.dtype.itemsize
         used = self.num_pages - len(self._free) - len(self._reclaimable)
+        if self.offload is not None:
+            host = dict(self.offload.get_stats())
+            host.update({
+                "host_hit_pages_admit": self._host_hit_pages,
+                "host_hit_tokens": self._host_hit_tokens,
+                "uploaded_pages": self._upload_pages,
+                "uploaded_bytes": self._upload_bytes,
+                "pending_offload": len(self._pending_offload),
+                "pending_upload": len(self._pending_upload),
+            })
+        else:
+            host = None
         return {
             "num_pages": self.num_pages,
             "page_size": self.page_size,
@@ -410,4 +581,5 @@ class PagedKVCache:
             "prefix_indexed": len(self._prefix_index),
             "hbm_bytes": bytes_total,
             "hbm_gib": bytes_total / (1 << 30),
+            **({"host_tier": host} if host is not None else {}),
         }

@@ -183,7 +183,8 @@ def engine_from_config(cfg):
     for k in ("page_size", "num_pages", "decode_steps_per_call",
               "attention_impl", "kv_dtype", "prefill_buckets",
               "prefix_cache", "prefill_chunk", "decode_mode",
-              "max_waiting", "queue_deadline_s"):
+              "max_waiting", "queue_deadline_s",
+              "kv_offload", "kv_offload_bytes"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

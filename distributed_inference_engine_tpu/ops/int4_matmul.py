@@ -388,10 +388,12 @@ def _cp_local_fallback(xlo, xhi, packed, scale):
 
 @functools.lru_cache(maxsize=2)
 def _cp_stacked(interpret: bool):
-    from jax.experimental.custom_partitioning import (
-        SdyShardingRule,
-        custom_partitioning,
-    )
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    try:  # Shardy rule (jax with the Sdy partitioner); else GSPMD callbacks
+        from jax.experimental.custom_partitioning import SdyShardingRule
+    except ImportError:                               # pragma: no cover
+        SdyShardingRule = None
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def _impl(xlo, xhi, packed, scale, layer):
@@ -441,11 +443,27 @@ def _cp_stacked(interpret: bool):
 
         return mesh, lower_fn, out_sharding, arg_shardings
 
-    rule = SdyShardingRule(
-        operand_mappings=(("m", "j"), ("m", "j"), ("l", "j", "n"),
-                          ("l", "z", "n"), ("o",)),
-        result_mappings=(("m", "n"),),
-        reduction_factors=("j",),
-    )
-    cp.def_partition(partition=_partition, sharding_rule=rule)
+    if SdyShardingRule is not None:
+        rule = SdyShardingRule(
+            operand_mappings=(("m", "j"), ("m", "j"), ("l", "j", "n"),
+                              ("l", "z", "n"), ("o",)),
+            result_mappings=(("m", "n"),),
+            reduction_factors=("j",),
+        )
+        cp.def_partition(partition=_partition, sharding_rule=rule)
+    else:
+        # pre-Shardy jax: express the same rule through the GSPMD
+        # callbacks — output inherits (m from x, n from the payload); the
+        # j (reduction) factor is handled by _partition's psum
+        def _infer(mesh, arg_infos, result_infos):
+            xs = (arg_infos[0].sharding.spec if arg_infos[0].sharding
+                  else P())
+            ps = (arg_infos[2].sharding.spec if arg_infos[2].sharding
+                  else P(None, None, None))
+            m_ax = xs[0] if len(xs) > 0 else None
+            n_ax = ps[2] if len(ps) > 2 else None
+            return NamedSharding(mesh, P(m_ax, n_ax))
+
+        cp.def_partition(partition=_partition,
+                         infer_sharding_from_operands=_infer)
     return cp

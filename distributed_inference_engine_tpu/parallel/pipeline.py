@@ -42,6 +42,13 @@ try:
 except ImportError:                                   # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+# the replication-check kwarg was renamed check_rep -> check_vma across jax
+# versions; pass whichever this jax spells
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma" if "check_vma"
+             in _inspect.signature(_shard_map).parameters else "check_rep")
+
 from ..models.base import (
     ModelSpec,
     Params,
@@ -130,7 +137,7 @@ def pipeline_hidden(
         _shard_map, mesh=mesh,
         in_specs=(blocks_spec, P(None, "dp"), P(None, "dp")),
         out_specs=P(None, "dp"),
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     def run(blocks, xs, lens):
         stage = lax.axis_index("pp")

@@ -37,7 +37,8 @@ def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int,
     """Per-device body: q/k/v are LOCAL blocks [B, Tl, H|Hkv, Dh]."""
     b, tl, h, dh = q.shape
     g = h // n_kv_heads
-    n = lax.axis_size(axis)
+    n = (lax.axis_size(axis) if hasattr(lax, "axis_size")
+         else lax.psum(1, axis))           # psum(1): pre-0.5 jax spelling
     idx = lax.axis_index(axis)
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
 
@@ -50,7 +51,9 @@ def _ring_body(q, k, v, seq_lens, *, axis: str, n_kv_heads: int,
     def _vary(x):
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axis, to="varying")
-        return lax.pvary(x, axis)                     # older jax
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, axis)                 # older jax
+        return x          # pre-varying-types jax: carries already match
 
     m = _vary(jnp.full((b, n_kv_heads, g, tl), NEG_INF, dtype=jnp.float32))
     l = _vary(jnp.zeros((b, n_kv_heads, g, tl), dtype=jnp.float32))

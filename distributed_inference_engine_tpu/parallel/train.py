@@ -37,6 +37,15 @@ def make_train_step(
             lambda x, s: jax.device_put(x, s), params, shardings.params
         )
         opt_state = tx.init(params)
+        # mu/nu inherit the param shardings via zeros_like; scalar leaves
+        # (adam's step count) land uncommitted on one device — replicate
+        # them so the whole state lives on the mesh's device set
+        from jax.sharding import NamedSharding
+
+        opt_state = jax.tree.map(
+            lambda x: x if isinstance(getattr(x, "sharding", None),
+                                      NamedSharding)
+            else jax.device_put(x, shardings.replicated), opt_state)
         return params, opt_state
 
     def step(state, tokens, seq_lens):
@@ -48,10 +57,27 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         return (params, opt_state), loss
 
-    train_step = jax.jit(
-        step,
-        in_shardings=(None, shardings.batch, shardings.replicated),
-        out_shardings=(None, shardings.replicated),
-        donate_argnums=(0,),
-    )
+    # donation requires out shardings to MATCH the donated inputs exactly;
+    # leaving the state's out_shardings unpinned lets GSPMD re-shard e.g. a
+    # replicated norm scale over tp, and the aliasing check then fails with
+    # a size mismatch. Pin both sides to the live state's own shardings
+    # (mu/nu mirror the params: optax builds them with zeros_like, which
+    # preserves sharding) — resolved lazily at the first call so init_state
+    # stays the single owner of placement.
+    cache: dict = {}
+
+    def train_step(state, tokens, seq_lens):
+        fn = cache.get("fn")
+        if fn is None:
+            state_sh = jax.tree.map(lambda x: x.sharding, state)
+            fn = jax.jit(
+                step,
+                in_shardings=(state_sh, shardings.batch,
+                              shardings.replicated),
+                out_shardings=(state_sh, shardings.replicated),
+                donate_argnums=(0,),
+            )
+            cache["fn"] = fn
+        return fn(state, tokens, seq_lens)
+
     return init_state, train_step

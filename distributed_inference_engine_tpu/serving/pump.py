@@ -191,6 +191,13 @@ class EnginePump:
                     self.engine.submit_prefilled(req, handoff, on_tokens=cb)
                 else:
                     self.engine.submit(req, on_tokens=cb)
+                    # host-tier prefetch (kv_offload): start host→device
+                    # uploads for cached prefix pages NOW, so the PCIe
+                    # copy overlaps queue wait + batch formation instead
+                    # of the admission critical path
+                    prefetch = getattr(self.engine, "prefetch_probe", None)
+                    if prefetch is not None:
+                        prefetch(req)
             except EngineOverloadedError as e:
                 # per-request outcome, not an exception: batch siblings
                 # already submitted must keep their futures resolvable

@@ -18,6 +18,7 @@ Usage (defaults mirror bench.py serving mode at the 8B rung):
     python examples/serving_sweep.py
     SWEEP_RATES=4,8,12 SWEEP_REQUESTS=96 SWEEP_TRIALS=5 \
         python examples/serving_sweep.py
+    SWEEP_SHAPE=long python examples/serving_sweep.py   # 2k-prompt rung
 Prints one JSON line per rate (the median trial, annotated with the
 band) and a final markdown table on stderr.
 """
@@ -34,6 +35,18 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 # bench's memory shape — serving adds per-bucket compiled programs and
 # admission-prefill workspace on top, and bs128 OOMs the 16 GB chip
 os.environ.setdefault("BENCH_BATCH", "64")
+# SWEEP_SHAPE=long: the long-prompt rung (2048-token prompts, 128 new).
+# At 8B/bs64 the KV footprint is 2176 tokens/slot — fp16 KV would blow the
+# 16 GB chip, so this shape forces fp8 KV and chunked prefill, and turns
+# the host KV tier on so evicted long prefixes restage over PCIe instead
+# of recomputing a 2k prefill. All setdefault: any knob can still be
+# overridden from the environment.
+if os.environ.get("SWEEP_SHAPE", "") == "long":
+    os.environ.setdefault("BENCH_PROMPT", "2048")
+    os.environ.setdefault("BENCH_NEW_TOKENS", "128")
+    os.environ.setdefault("BENCH_PREFILL_CHUNK", "512")
+    os.environ.setdefault("BENCH_KV_DTYPE", "float8_e4m3fn")
+    os.environ.setdefault("BENCH_KV_OFFLOAD", "1")
 
 import numpy as np  # noqa: E402
 
@@ -125,6 +138,7 @@ def main():
     if os.environ.get("BENCH_DEFER_ADMIT", "") == "0":
         engine.config.defer_admission = False
     log(f"engine init ({bench.MODEL}, bs{bench.BATCH}, "
+        f"prompt={bench.PROMPT_LEN}+{bench.NEW_TOKENS}, "
         f"quant={bench.QUANT_BITS if bench.QUANT else 0}, "
         f"max_waiting={engine.config.max_waiting}, "
         f"deadline={engine.config.queue_deadline_s}s): "

@@ -24,15 +24,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: the suite compiles hundreds of XLA programs
-# (mesh variants × bucket shapes) on one CPU core; caching them across test
-# processes and across runs is the single biggest suite-time lever
-# (VERDICT r1 item 8). Keyed by HLO, so spec shrinkage elsewhere still
-# invalidates correctly.
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_TEST_CACHE_DIR", "/tmp/jax_test_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent compilation cache: DISABLED on this jaxlib. It was the single
+# biggest suite-time lever (VERDICT r1 item 8), but on the pinned CPU
+# jaxlib executing a cache-deserialized executable intermittently segfaults
+# (native crash in libstdc++ under dispatch) or silently returns WRONG
+# numerics — two identical engines built in one test diverge because the
+# second hits the entry the first just wrote. Measured: test_families alone
+# crashed 5/8 runs with the cache on (fresh OR warm dir, thunk runtime on
+# or off) and passed 5/5 with it off; full-suite runs died at ~18% with a
+# corrupted-heap segfault/abort. A slower suite beats a coin-flip suite.
+# Re-enable (restore jax_compilation_cache_dir + the two thresholds) only
+# after validating deserialization on an upgraded jaxlib.
+jax.config.update("jax_enable_compilation_cache", False)
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

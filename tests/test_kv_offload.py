@@ -1,0 +1,297 @@
+"""Host-RAM KV tier tests (engine/kv_offload.py + the two-tier plumbing in
+engine/paged_kv.py and engine/continuous.py).
+
+Correctness bar, same as the device prefix cache: the host tier must be
+token-for-token invisible. A prefix that was evicted to host and prefetched
+back produces bit-identical greedy tokens to a never-evicted run, and a
+swap-preempted decode slot resumes WITHOUT re-running prefill (asserted via
+``prefill_calls``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.config import EngineConfig
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.kv_offload import HostKVOffload
+from distributed_inference_engine_tpu.engine.paged_kv import PagedKVCache
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.base import init_params
+from distributed_inference_engine_tpu.models.llama import llama_spec
+
+SPEC = llama_spec("llama-tiny", max_seq_len=128)
+PAGE = 8
+SYS = list(range(1, 25))          # 24 tokens = 3 full pages of shared prefix
+
+
+def _cfg(num_pages=8, offload=True, **over):
+    # kv_dtype matches the spec dtype so offload-on/off comparisons are
+    # exact (see test_prefix_cache.py for the argmax-tie rationale)
+    base = dict(max_slots=4, max_seq_len=128, page_size=PAGE,
+                num_pages=num_pages, decode_steps_per_call=4,
+                attention_impl="xla", prefix_cache=True,
+                kv_dtype="float32", kv_offload=offload)
+    base.update(over)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(SPEC, jax.random.key(0))
+
+
+# ------------------------------------------------------- store unit tests
+
+
+def _page(fill, nbytes=64):
+    a = np.full(nbytes // 8, fill, np.float32)
+    return a, a.copy()            # 2 * nbytes/2 = nbytes per put
+
+
+def test_host_lru_evicts_by_bytes():
+    store = HostKVOffload(max_bytes=3 * 64)
+    for i in range(3):
+        assert store.put(bytes([i]), *_page(i))
+    assert len(store) == 3 and store._lru_bytes == 3 * 64
+    # a get refreshes recency: key 0 survives the next eviction, key 1 dies
+    assert store.get(bytes([0])) is not None
+    assert store.put(bytes([3]), *_page(3))
+    assert store.probe(bytes([0])) and not store.probe(bytes([1]))
+    st = store.get_stats()
+    assert st["host_evicted_pages"] == 1
+    assert st["host_pages"] == 3 and st["host_lru_bytes"] == 3 * 64
+
+
+def test_host_store_rejects_oversized_page():
+    store = HostKVOffload(max_bytes=64)
+    assert not store.put(b"big", *_page(0, nbytes=128))
+    assert store.get_stats()["host_rejected_pages"] == 1
+    assert len(store) == 0
+
+
+def test_swap_reservation_displaces_lru_but_is_never_evicted():
+    store = HostKVOffload(max_bytes=2 * 64)
+    store.put(b"a", *_page(1))
+    store.put(b"b", *_page(2))
+    # reserving one page's worth evicts the LRU entry (a), keeps b
+    assert store.reserve_swap(64)
+    assert not store.probe(b"a") and store.probe(b"b")
+    # a put under the reservation respects the reduced budget: it must
+    # evict b, never the reservation
+    assert store.put(b"c", *_page(3))
+    assert not store.probe(b"b") and store.probe(b"c")
+    assert store._swap_bytes == 64
+    # an unsatisfiable reservation is refused outright
+    assert not store.reserve_swap(2 * 64)
+    store.release_swap(64)
+    assert store._swap_bytes == 0
+
+
+def test_admit_false_for_stored_or_disabled():
+    store = HostKVOffload(max_bytes=128)
+    assert store.admit(b"x")
+    store.put(b"x", *_page(0))
+    assert not store.admit(b"x")      # contents immutable: re-offload is waste
+    assert not HostKVOffload(max_bytes=0).admit(b"x")
+
+
+# ------------------------------------------- cache-level round trip (exact)
+
+
+def _synthetic_pools(kv):
+    """Distinct recognizable contents per (layer, page, slot-in-page)."""
+    shape = kv.k_pages.shape
+    base = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    return jnp.asarray(base), jnp.asarray(-base)
+
+
+def test_offload_roundtrip_restores_exact_page_contents():
+    """evict→offload→host-hit→upload restores bit-identical page bytes,
+    even after the device pool was overwritten in between."""
+    kv = PagedKVCache(SPEC, max_slots=2, page_size=PAGE, num_pages=4,
+                      max_seq_len=128, dtype="float32",
+                      offload=HostKVOffload())
+    kv.swap(*_synthetic_pools(kv))
+    want_k = np.asarray(kv.k_pages)
+    want_v = np.asarray(kv.v_pages)
+
+    s1, _ = kv.alloc_slot_prefix(SYS)                 # 3 pages
+    pages1 = list(kv._slot_pages[s1])
+    kv.register_prefix(s1, SYS)
+    kv.free_slot(s1)
+
+    # 4-page alloc reclaims all 3 cached pages → offload queued, flushed
+    s2 = kv.alloc_slot(32)
+    assert s2 is not None
+    assert len(kv._pending_offload) == 3
+    kv.sync_tiers()
+    assert kv.offload.get_stats()["offloaded_pages"] == 3
+    kv.free_slot(s2)
+
+    # simulate the overwriting dispatch: the pool no longer holds the KV
+    kv.swap(jnp.zeros_like(kv.k_pages), jnp.zeros_like(kv.v_pages))
+
+    s3, n_cached = kv.alloc_slot_prefix(SYS)
+    # matchable prefix of a 24-token prompt is (24-1)//8 = 2 pages
+    assert n_cached == 2 * PAGE
+    assert kv.get_stats()["host_tier"]["host_hit_pages_admit"] == 2
+    kv.sync_tiers()                                   # upload scatter lands
+
+    got_k, got_v = kv._gather_pages(kv._slot_pages[s3][:2])
+    np.testing.assert_array_equal(got_k, want_k[:, pages1[:2]])
+    np.testing.assert_array_equal(got_v, want_v[:, pages1[:2]])
+
+
+def test_reclaim_drops_stale_pending_upload_instead_of_offloading():
+    """A host-hit landing page reclaimed BEFORE its upload flushed holds
+    stale device bytes: the upload must be dropped (not scattered, not
+    re-offloaded) and the store copy stays authoritative."""
+    kv = PagedKVCache(SPEC, max_slots=2, page_size=PAGE, num_pages=4,
+                      max_seq_len=128, dtype="float32",
+                      offload=HostKVOffload())
+    kv.swap(*_synthetic_pools(kv))
+    want_k = np.asarray(kv.k_pages)
+
+    s1, _ = kv.alloc_slot_prefix(SYS)
+    pages1 = list(kv._slot_pages[s1])
+    kv.register_prefix(s1, SYS)
+    kv.free_slot(s1)
+    s2 = kv.alloc_slot(32)                            # evict+offload all 3
+    kv.sync_tiers()
+    kv.free_slot(s2)
+    kv.swap(jnp.zeros_like(kv.k_pages), jnp.zeros_like(kv.v_pages))
+
+    s3, _ = kv.alloc_slot_prefix(SYS)                 # 2 staged uploads
+    assert len(kv._pending_upload) == 2
+    # free WITHOUT syncing, then reclaim the landing pages (staging indexed
+    # them, so they park in _reclaimable and a 4-page alloc takes them)
+    kv.free_slot(s3)
+    s4 = kv.alloc_slot(32)
+    assert s4 is not None
+    assert not kv._pending_upload                     # stale uploads dropped
+    assert not kv._pending_offload                    # stale bytes never offloaded
+    kv.sync_tiers()
+    kv.free_slot(s4)
+
+    # the store still serves the authoritative bytes on the next hit
+    s5, n_cached = kv.alloc_slot_prefix(SYS)
+    assert n_cached == 2 * PAGE
+    kv.sync_tiers()
+    got_k, _ = kv._gather_pages(kv._slot_pages[s5][:2])
+    np.testing.assert_array_equal(got_k, want_k[:, pages1[:2]])
+
+
+# --------------------------------------------------- engine-level parity
+
+
+def _req(rid="r", prompt=None, max_new=6):
+    return GenerationRequest(prompt=list(prompt or (SYS + [30, 31])),
+                             max_new_tokens=max_new, temperature=0.0,
+                             request_id=rid)
+
+
+def test_evicted_prefix_offloads_then_prefetches_with_exact_parity(params):
+    """The acceptance scenario: a prefix evicted from the device pool is
+    offloaded to host, a later request sharing it hits the host tier, and
+    its greedy tokens are bit-identical to the never-evicted run."""
+    want = ContinuousEngine(SPEC, params=params,
+                            config=_cfg(offload=False, num_pages=64)
+                            ).generate([_req("w")])[0].tokens
+
+    eng = ContinuousEngine(SPEC, params=params, config=_cfg(num_pages=8))
+    first = eng.generate([_req("r1")])[0].tokens
+    assert first == want
+    # a distinct long request grows through the whole pool, reclaiming the
+    # cached SYS pages → they offload to host
+    eng.generate([_req("r2", prompt=list(range(200, 240)), max_new=24)])
+    host = eng.get_metrics()["kv"]["host_tier"]
+    assert host["offloaded_pages"] >= 3
+    assert eng.kv.get_stats()["prefix_indexed"] == 0 or \
+        not any(h in eng.kv._prefix_index
+                for h in eng.kv._page_hashes(SYS + [30, 31], 3))
+
+    again = eng.generate([_req("r3")])[0].tokens
+    assert again == want
+    m = eng.get_metrics()
+    host = m["kv"]["host_tier"]
+    assert host["host_hit_pages_admit"] >= 1
+    assert host["uploaded_pages"] >= 1
+    assert host["uploaded_bytes"] > 0
+    assert m["kv_offload"]["prefetch_hidden_latency_est_s"] > 0.0
+
+
+def test_prefetch_probe_stages_async_uploads(params):
+    """The serving-pump hook: prefetch_probe on an evicted-but-host-
+    resident prefix starts device_put uploads ahead of admission; the
+    generation still matches exactly."""
+    want = ContinuousEngine(SPEC, params=params,
+                            config=_cfg(offload=False, num_pages=64)
+                            ).generate([_req("w")])[0].tokens
+    eng = ContinuousEngine(SPEC, params=params, config=_cfg(num_pages=8))
+    eng.generate([_req("r1")])
+    eng.generate([_req("r2", prompt=list(range(200, 240)), max_new=24)])
+
+    r3 = _req("r3")
+    started = eng.prefetch_probe(r3)
+    assert started >= 1
+    assert eng.get_metrics()["kv"]["host_tier"]["host_staged_pages"] >= 1
+    assert eng.generate([r3])[0].tokens == want
+
+
+def test_swap_preemption_resumes_without_prefill(params):
+    """Pool exhaustion mid-decode parks a victim on the host tier and
+    resumes it later: no "length" finish, no prefill re-run, and tokens
+    bit-identical to a pool that never exhausts."""
+    reqs = lambda: [_req("a", prompt=list(range(50, 70)), max_new=20),
+                    _req("b", prompt=list(range(80, 100)), max_new=20)]
+    big = ContinuousEngine(SPEC, params=params,
+                           config=_cfg(offload=False, num_pages=64,
+                                       max_slots=2))
+    want = {r.request_id: r.tokens for r in big.generate(reqs())}
+    assert all(len(t) == 20 for t in want.values())
+    base_prefills = big.get_metrics()["prefill_calls"]
+
+    # 2 slots × 20-token prompts fill all 6 pages at admission; growth past
+    # 24 tokens must preempt — with the host tier it swaps instead of
+    # finishing with reason="length"
+    eng = ContinuousEngine(SPEC, params=params,
+                           config=_cfg(num_pages=6, max_slots=2))
+    got = {r.request_id: r.tokens for r in eng.generate(reqs())}
+    assert got == want
+    m = eng.get_metrics()
+    assert m["kv_offload"]["swap_outs"] >= 1
+    assert m["kv_offload"]["swap_resumes"] >= 1
+    assert m["kv_offload"]["swapped_parked"] == 0
+    assert m["capacity_finishes"] == 0
+    # the acceptance invariant: resume is install+upload, never a prefill
+    assert m["prefill_calls"] == base_prefills
+
+
+def test_swap_falls_back_to_length_finish_when_host_budget_refuses(params):
+    """kv_offload_bytes too small for even one slot's pages: the engine
+    must degrade to the old capacity-finish behavior, not wedge."""
+    eng = ContinuousEngine(
+        SPEC, params=params,
+        config=_cfg(num_pages=6, max_slots=2, kv_offload_bytes=1))
+    out = {r.request_id: r for r in eng.generate(
+        [_req("a", prompt=list(range(50, 70)), max_new=20),
+         _req("b", prompt=list(range(80, 100)), max_new=20)])}
+    assert len(out) == 2
+    m = eng.get_metrics()
+    assert m["kv_offload"]["swap_outs"] == 0
+    assert m["kv_offload"]["swap_fallback_finishes"] >= 1
+    assert m["capacity_finishes"] >= 1
+    # the capacity-finished request was truncated, not lost
+    assert any(r.finish_reason == "length" and 0 < len(r.tokens) < 20
+               for r in out.values())
+
+
+def test_offload_disabled_is_the_default_and_adds_no_metrics(params):
+    eng = ContinuousEngine(SPEC, params=params,
+                           config=_cfg(offload=False, num_pages=64))
+    eng.generate([_req()])
+    m = eng.get_metrics()
+    assert "kv_offload" not in m
+    assert "host_tier" not in m["kv"]
+    assert eng._offload is None

@@ -27,8 +27,11 @@ def test_gpt2_round_trip(tmp_path):
     save_checkpoint_gpt2(str(tmp_path), params, TINY_GPT2)
     loaded = load_checkpoint(str(tmp_path), TINY_GPT2)
     # same tree structure, same values
-    flat1 = jax.tree.leaves_with_path(params)
-    flat2 = jax.tree.leaves_with_path(loaded)
+    # jax.tree.leaves_with_path is missing on older jax; the tree_util
+    # spelling exists on every version in support
+    from jax.tree_util import tree_leaves_with_path
+    flat1 = tree_leaves_with_path(params)
+    flat2 = tree_leaves_with_path(loaded)
     assert len(flat1) == len(flat2)
     for (p1, a1), (p2, a2) in zip(sorted(flat1, key=lambda x: str(x[0])),
                                   sorted(flat2, key=lambda x: str(x[0]))):

@@ -5,6 +5,9 @@ process-global state the shared test process must not absorb."""
 import subprocess
 import sys
 
+import jax
+import pytest
+
 from distributed_inference_engine_tpu.config import MeshConfig
 from distributed_inference_engine_tpu.parallel.multihost import global_mesh
 
@@ -122,6 +125,14 @@ def test_initialize_multihost_two_real_processes():
     round-2 suite never exercised beyond num_processes=1."""
     import pathlib
     import socket
+
+    # older jaxlib CPU backends reject multi-process computations outright
+    # ("Multiprocess computations aren't implemented on the CPU backend")
+    # — nothing to shim around; the single-process multihost tests above
+    # still cover the mesh/pspec plumbing
+    if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5):
+        pytest.skip("multi-process CPU collectives unsupported on this "
+                    f"jaxlib (jax {jax.__version__})")
 
     repo_root = str(pathlib.Path(__file__).resolve().parents[1])
     s = socket.socket()

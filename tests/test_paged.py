@@ -341,3 +341,116 @@ def test_forward_prefill_into_pages_matches_two_program_path():
     # pad row's pages (incl. page 0, which its zeroed table row points
     # at) keep the sentinel fill where no valid token landed
     assert float(kp_got[:, 6:].min()) == -7.0
+
+
+# --------------------------------------- eviction order under pin pressure
+
+
+def _cache(num_pages, max_slots=4):
+    return PagedKVCache(SPEC, max_slots=max_slots, page_size=16,
+                        num_pages=num_pages, max_seq_len=256,
+                        dtype="float32")
+
+
+def _prompt(base, n_tokens=16):
+    return list(range(base, base + n_tokens))
+
+
+def test_pinned_prefix_pages_never_reclaimed():
+    """A cached page re-pinned by a live slot must be invisible to
+    _take_free, even when it is the ONLY reclaimable candidate left —
+    allocation fails rather than stealing pinned KV (the hazard at
+    alloc_slot_prefix's pin-before-source ordering)."""
+    kv = _cache(num_pages=3)
+    pa = _prompt(0)
+    s1, _ = kv.alloc_slot_prefix(pa)            # 1 page
+    kv.register_prefix(s1, pa)
+    kv.free_slot(s1)
+    assert list(kv._reclaimable)                # cached, ref 0
+
+    s2, n2 = kv.alloc_slot_prefix(pa + _prompt(100, 32))   # re-pins pa's page
+    assert s2 is not None and n2 == 16
+    pinned = kv._slot_pages[s2][0]
+    assert pinned not in kv._reclaimable and kv._page_ref[pinned] == 1
+
+    # pool: 3 pages, all owned by s2 now → nothing reclaimable, nothing free
+    assert kv.available_pages == 0
+    assert kv._take_free(1) is None             # must NOT hand out the pin
+    assert kv.alloc_slot(4) is None
+    # s2's table is intact and alias-free
+    pages = kv._slot_pages[s2]
+    assert len(set(pages)) == len(pages) == 3
+
+
+def test_reclaim_order_is_recency_not_registration():
+    """Re-pinning a cached chain and releasing it moves it to MRU: the
+    next reclaim under pressure takes the least-recently-USED chain, not
+    the first-registered one."""
+    kv = _cache(num_pages=3)
+    chains = [_prompt(0), _prompt(1000), _prompt(2000)]
+    for c in chains:                            # cache A, then B, then C
+        s, _ = kv.alloc_slot_prefix(c)
+        kv.register_prefix(s, c)
+        kv.free_slot(s)
+    assert kv.get_stats()["pages_cached"] == 3
+
+    # touch A: re-admit + free → A becomes most-recently-used
+    s, n = kv.alloc_slot_prefix(chains[0] + [7])
+    assert n == 16
+    kv.free_slot(s)
+
+    ha, hb, hc = (kv._page_hashes(c, 1)[0] for c in chains)
+    # one writable page under full-cache pressure must evict B (oldest)
+    s2 = kv.alloc_slot(4)
+    assert s2 is not None
+    assert hb not in kv._prefix_index
+    assert ha in kv._prefix_index and hc in kv._prefix_index
+
+
+def test_pin_churn_stress_invariants():
+    """Deterministic churn of shared-prefix admissions, growth, and frees
+    against a tight pool: after every operation the allocator invariants
+    hold — no page in two tables, no pinned page free/reclaimable, and
+    free/reclaimable disjoint."""
+    kv = _cache(num_pages=10, max_slots=4)
+    rs = np.random.RandomState(7)
+    prompts = [_prompt(b, 40) for b in (0, 500, 0, 9000)]  # 0 shared twice
+    live = {}
+
+    def check():
+        owned = [p for pages in kv._slot_pages.values() for p in pages]
+        for pages in kv._slot_pages.values():
+            assert len(set(pages)) == len(pages), f"aliased table {pages}"
+        free, recl = set(kv._free), set(kv._reclaimable)
+        assert not free & recl
+        assert not set(owned) & free and not set(owned) & recl
+        for p, r in kv._page_ref.items():
+            assert r >= 1
+            assert p not in free and p not in recl
+        # every reclaimable page is indexed; every indexed page exists
+        for p in recl:
+            assert p in kv._page_key
+        for h, p in kv._prefix_index.items():
+            assert kv._page_key.get(p) == h
+
+    for it in range(60):
+        op = rs.randint(3)
+        if op == 0 and len(live) < 4:
+            pi = rs.randint(len(prompts))
+            got = kv.alloc_slot_prefix(prompts[pi])
+            if got is not None:
+                slot, _ = got
+                kv.register_prefix(slot, prompts[pi])
+                live[slot] = prompts[pi]
+        elif op == 1 and live:
+            slot = list(live)[rs.randint(len(live))]
+            kv.ensure_capacity(slot, kv._slot_len[slot] + 16)
+        elif live:
+            slot = list(live)[rs.randint(len(live))]
+            kv.free_slot(slot)
+            del live[slot]
+        check()
+    for slot in list(live):
+        kv.free_slot(slot)
+    check()
+    assert kv.available_pages == 10

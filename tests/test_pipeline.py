@@ -97,8 +97,11 @@ def test_pp_gradients_match_dense():
     g_ref = jax.grad(lambda p: causal_lm_loss(SPEC, p, tokens, lens))(params)
     g_pp = jax.grad(lambda p: pipeline_lm_loss(SPEC, p, tokens, lens, mesh,
                                                n_micro=2))(params)
-    flat_ref = jax.tree.leaves_with_path(g_ref)
-    flat_pp = {str(k): v for k, v in jax.tree.leaves_with_path(g_pp)}
+    # jax.tree.leaves_with_path is missing on older jax; the tree_util
+    # spelling exists on every version in support
+    from jax.tree_util import tree_leaves_with_path
+    flat_ref = tree_leaves_with_path(g_ref)
+    flat_pp = {str(k): v for k, v in tree_leaves_with_path(g_pp)}
     for k, v in flat_ref:
         np.testing.assert_allclose(
             np.asarray(flat_pp[str(k)]), np.asarray(v),
