@@ -122,6 +122,13 @@ BATCH = int(os.environ.get("BENCH_BATCH", "128" if _BIG_INT4_CONT else "64"))
 PROMPT_LEN = int(os.environ.get("BENCH_PROMPT", "128"))
 NEW_TOKENS = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+# mixed workload (ISSUE 3): every BENCH_MIX_EVERY-th serving request
+# carries a BENCH_MIX_PROMPT-token prompt instead of PROMPT_LEN — a steady
+# decode stream with periodic long-prompt admissions, the shape whose ITL
+# cliff the ragged mixed step exists to flatten. 0 disables.
+MIX_EVERY = int(os.environ.get("BENCH_MIX_EVERY", "0"))
+MIX_PROMPT = int(os.environ.get("BENCH_MIX_PROMPT", "2048"))
+MAX_PROMPT = max(PROMPT_LEN, MIX_PROMPT) if MIX_EVERY else PROMPT_LEN
 
 
 def log(msg: str) -> None:
@@ -174,8 +181,8 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
 
     cfg = EngineConfig(
         max_slots=batch,
-        max_seq_len=min(spec.max_seq_len, PROMPT_LEN + NEW_TOKENS),
-        prefill_buckets=[PROMPT_LEN],
+        max_seq_len=min(spec.max_seq_len, MAX_PROMPT + NEW_TOKENS),
+        prefill_buckets=sorted({PROMPT_LEN, MAX_PROMPT}),
         decode_steps_per_call=steps,
     )
     if os.environ.get("BENCH_KV_DTYPE"):
@@ -233,7 +240,11 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
         raw = int(os.environ["BENCH_PREFILL_CHUNK"])
         cfg.prefill_chunk = raw
         chunk = max(cfg.page_size, raw // cfg.page_size * cfg.page_size)
-        cfg.prefill_buckets = sorted({chunk, PROMPT_LEN})
+        cfg.prefill_buckets = sorted({chunk, PROMPT_LEN, MAX_PROMPT})
+    if os.environ.get("BENCH_MIXED_TOKENS"):
+        # Sarathi-style prefill budget per mixed ragged step (takes effect
+        # with BENCH_ATTN=pallas-ragged and BENCH_PREFILL_CHUNK set)
+        cfg.mixed_step_tokens = int(os.environ["BENCH_MIXED_TOKENS"])
     if os.environ.get("BENCH_KV_OFFLOAD", "") not in ("", "0"):
         # host-RAM KV tier: evicted prefix pages offload instead of
         # dropping, admission prefetches host hits back, pool exhaustion
@@ -327,9 +338,18 @@ def _requests(spec, seed: int, n: int):
     )
 
     rs = np.random.RandomState(seed)
+
+    def _plen(i: int) -> int:
+        # periodic long-prompt admissions into a steady short-prompt
+        # stream (SWEEP_SHAPE=mixed); the first request stays short so
+        # the decode stream establishes before the first admission burst
+        if MIX_EVERY and i > 0 and i % MIX_EVERY == 0:
+            return min(MIX_PROMPT, spec.max_seq_len - NEW_TOKENS)
+        return PROMPT_LEN
+
     return [
         GenerationRequest(
-            prompt=rs.randint(0, spec.vocab_size, size=PROMPT_LEN).tolist(),
+            prompt=rs.randint(0, spec.vocab_size, size=_plen(i)).tolist(),
             max_new_tokens=NEW_TOKENS,
             temperature=0.0,
             request_id=f"bench-{seed}-{i}",
@@ -515,6 +535,10 @@ def serving_main() -> None:
     m = engine.get_metrics()
     toks_per_s = total_toks / wall
     ttft_p50, ttft_p99 = pct(ttfts, 0.5) * 1e3, pct(ttfts, 0.99) * 1e3
+    # p50 next to p99: the mixed-step claim is about the TAIL (admissions
+    # must not cliff p99 above ~2x the steady-state median), so both ends
+    # of the ITL distribution are first-class outputs
+    itl_p50 = pct(itls, 0.5) * 1e3
     itl_p99 = pct(itls, 0.99) * 1e3
     d_steps = m["engine_steps"] - steps0
     occ = ((m["batch_occupancy"] * m["engine_steps"] * engine.max_slots
@@ -523,8 +547,8 @@ def serving_main() -> None:
     log(f"served {len(reqs)} reqs ({total_toks} tokens) in {wall:.1f}s at "
         f"offered rate {rate}/s -> {toks_per_s:.1f} tok/s goodput; "
         f"rejected {rejected[0]} ({rej_rate:.0%}); TTFT p50 "
-        f"{ttft_p50:.0f} ms p99 {ttft_p99:.0f} ms; ITL p99 {itl_p99:.1f} ms; "
-        f"occupancy {occ:.2f}")
+        f"{ttft_p50:.0f} ms p99 {ttft_p99:.0f} ms; ITL p50 {itl_p50:.1f} ms "
+        f"p99 {itl_p99:.1f} ms; occupancy {occ:.2f}")
     print(json.dumps({
         "metric": f"serving_throughput_{MODEL}"
                   f"{f'_int{QUANT_BITS}' if QUANT else ''}"
@@ -534,6 +558,7 @@ def serving_main() -> None:
         "vs_baseline": round(toks_per_s / NORTH_STAR_TOKS, 2),
         "ttft_p50_ms": round(ttft_p50, 1),
         "ttft_p99_ms": round(ttft_p99, 1),
+        "itl_p50_ms": round(itl_p50, 2),
         "itl_p99_ms": round(itl_p99, 2),
         "occupancy": round(occ, 3),
         "rejected": rejected[0],

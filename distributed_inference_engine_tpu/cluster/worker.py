@@ -304,7 +304,10 @@ class WorkerServer(FramedServerMixin):
         if hasattr(engine, "submit") and hasattr(engine, "step"):
             from ..serving.pump import EnginePump
 
-            self._pumps[cfg.name] = EnginePump(engine)
+            self._pumps[cfg.name] = EnginePump(
+                engine,
+                mixed_step_tokens=(
+                    int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
         logger.info("worker %s loaded model %s (%s) in %.2fs",
                     self.worker_id, cfg.name, cfg.architecture,
                     time.perf_counter() - t0)

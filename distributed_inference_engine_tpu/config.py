@@ -98,7 +98,11 @@ class EngineConfig:
     # "pallas-decode" (fused flash-decode kernel: paged prefix + side
     # window in ONE pallas_call per layer, ops/flash_decode.py) |
     # "pallas-decode-fw" (same + fresh-KV side writeback in the kernel
-    # epilogue); append "_interpret" to either for CPU interpret mode
+    # epilogue) | "pallas-ragged" (mixed-batch ragged kernel,
+    # ops/ragged_attention.py: decode rows AND prefill-chunk rows share
+    # one dispatch when prefill_chunk > 0; pure-decode chunks fall back
+    # to the flash-decode kernel); append "_interpret" to any for CPU
+    # interpret mode
     decode_mode: str = "window"        # continuous engine: "window" freezes
                                        # the page pools per chunk, gathers
                                        # the live prefix ONCE into a dense
@@ -127,6 +131,18 @@ class EngineConfig:
                                        # this prefill in chunks interleaved with
                                        # decode (0 = whole-prompt prefill);
                                        # rounded to a multiple of page_size
+    mixed_step_tokens: int = 0         # ragged mixed steps (attention_impl
+                                       # ="pallas-ragged" + prefill_chunk):
+                                       # cap the PREFILL tokens packed into
+                                       # one mixed dispatch, a la Sarathi —
+                                       # prefill admission is throttled by
+                                       # leftover compute instead of whole-
+                                       # step preemption. Row-granular: a
+                                       # step takes whole chunks (oldest
+                                       # first) until the budget is spent,
+                                       # always at least one so prefill
+                                       # can't starve. 0 = uncapped (every
+                                       # pending chunk rides every step)
     defer_admission: bool = True       # continuous engine: under decode
                                        # pressure (>=1/4 slots live), skip
                                        # the blocking first-token read at
@@ -195,6 +211,31 @@ class EngineConfig:
                                        # keeps the two-program shape.
 
 
+def validate_prefill_compose(prefill_chunk: int, sp: int = 1) -> None:
+    """Reject prefill_chunk + sequence-parallel deploys with an actionable
+    error — lifted out of ``ContinuousEngine.__init__`` so config loaders
+    (``models.engine_from_config`` reads both knobs from model metadata)
+    fail in milliseconds instead of after weights load. Both features bound
+    the decode stall a long-prompt admission causes — chunking bounds it in
+    TIME (prefill in page-aligned slices), sp bounds it in SPACE (shard the
+    prompt across the mesh) — and the suffix-chunk programs are not
+    sequence-parallel, so enabling both buys nothing and traces programs sp
+    would never run. Note this constraint is about the SPLIT chunked path
+    AND the ragged mixed path alike: neither prefill-chunk program shards
+    the sequence axis.
+    """
+    if int(sp) > 1 and int(prefill_chunk) > 0:
+        raise ValueError(
+            "prefill_chunk and sp compose poorly: both bound the "
+            "decode stall from long-prompt admission (chunking in "
+            "time, sp in space), and the suffix-chunk programs are "
+            "not sequence-parallel — pick one. Set prefill_chunk=0 "
+            "to keep the sp mesh, or sp=1 to keep chunked prefill. "
+            "Measured guidance (README, r3): chunking LOSES below "
+            "multi-second admission stalls, so sp is the right pick "
+            "for long-prompt deploys that have a mesh")
+
+
 @dataclass
 class BatcherConfig:
     """Reference ``src/batcher.py:38-51``: flush at max_batch_size OR after
@@ -203,6 +244,14 @@ class BatcherConfig:
     max_batch_size: int = 8
     max_latency_ms: float = 50.0
     pad_to_buckets: bool = True        # pad batches to power-of-two buckets for XLA
+    mixed_step_tokens: int = 0         # serving-layer hand-down of the
+                                       # engine's Sarathi-style prefill
+                                       # budget (EngineConfig
+                                       # .mixed_step_tokens): cluster
+                                       # workers forward it into the
+                                       # EnginePump so deploys can throttle
+                                       # admission prefill per mixed step
+                                       # without touching model metadata
 
 
 @dataclass

@@ -38,13 +38,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import EngineConfig
+from ..config import EngineConfig, validate_prefill_compose
 from ..models.base import (
     ModelSpec,
     Params,
     forward_decode,
     forward_decode_paged,
     forward_decode_window,
+    forward_mixed_step,
     forward_prefill_into_pages,
     forward_prefill_suffix,
     init_params,
@@ -291,15 +292,10 @@ class ContinuousEngine:
         spec_ = self.spec
         has_sp = (sp_mesh is not None
                   and sp_mesh.shape.get("sp", 1) > 1)
-        if has_sp and self._chunk:
-            raise ValueError(
-                "prefill_chunk and sp compose poorly: both bound the "
-                "decode stall from long-prompt admission (chunking in "
-                "time, sp in space), and the suffix-chunk programs are "
-                "not sequence-parallel — pick one. Measured guidance "
-                "(README, r3): chunking LOSES below multi-second "
-                "admission stalls, so sp is the right pick for long-"
-                "prompt deploys that have a mesh")
+        # compose rule lifted into config.validate_prefill_compose so
+        # metadata-driven loaders reject the pair before weights load;
+        # kept here too for engines constructed directly
+        validate_prefill_compose(self._chunk, sp=2 if has_sp else 1)
         if has_sp:
             from .engine import _check_same_mesh
 
@@ -382,8 +378,29 @@ class ContinuousEngine:
             return jnp.stack(
                 [first, jax.lax.bitcast_convert_type(lp, jnp.int32)]), ks, vs
 
-        fwd = partial(forward_decode_paged, attn_impl=self.attn_impl)
-        fwd_window = partial(forward_decode_window, attn_impl=self.attn_impl)
+        # mixed ragged dispatch (ops/ragged_attention.py): decode rows
+        # (q=1) and prefill-chunk rows (q=chunk) share ONE pallas_call per
+        # step, so admitting a long prompt no longer preempts decode for a
+        # whole suffix dispatch (ISSUE 3 / Sarathi). Pure-decode chunks —
+        # no prefill in flight — fall back to the q=1-specialised
+        # flash-decode kernel (same DMA pipeline, no per-row query pad).
+        if self.attn_impl.startswith("pallas-ragged"):
+            if spec_.sliding_window:
+                raise ValueError(
+                    "attention_impl='pallas-ragged' does not support "
+                    "sliding-window models: the ragged kernel carries no "
+                    "window mask (every context page is live). Use "
+                    "attention_impl='xla' for sliding-window specs."
+                )
+            decode_impl = "pallas-decode" + (
+                "_interpret" if self.attn_impl.endswith("_interpret")
+                else "")
+        else:
+            decode_impl = self.attn_impl
+        self._mixed = (self.attn_impl.startswith("pallas-ragged")
+                       and self._chunk > 0)
+        fwd = partial(forward_decode_paged, attn_impl=decode_impl)
+        fwd_window = partial(forward_decode_window, attn_impl=decode_impl)
         # Windowed chunks freeze the page pools for the duration of a decode
         # chunk — the per-step page scatter they replace held decode at ~28%
         # of the dense engine's throughput at 8B bs64. Small-KV models
@@ -538,6 +555,70 @@ class ContinuousEngine:
                 axis=0)
             return (kp, vp, lengths, last, active, produced), packed
 
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        def _mixed_chunk(
+            params, kp, vp, lengths, last_tokens, active, produced,
+            page_table, cap, max_new, sampling, eos_ids, firsts,
+            pf_tokens, pf_ctx, pf_qlens, pf_tables, pf_sampling, key,
+        ):
+            """One MIXED step: every decode slot (q<=1 rows) plus up to Rp
+            in-flight prefill chunks (q=chunk rows) run through ONE
+            forward_mixed_step dispatch — prefill rides in the decode
+            step's bandwidth shadow instead of preempting it for a whole
+            suffix program (ISSUE 3 / Sarathi). The decode batch is fixed
+            at max_slots, so compilation count is bounded by
+            (pf-row pow2 bucket) x (chunk bucket) — audited by
+            ``_mixed_programs`` and the compile-count guard test.
+
+            Decode rows advance exactly one token with the same
+            bookkeeping as ``_decode_chunk``'s per-step ``advance``; the
+            packed output row layout matches ``_process_packed`` at
+            n_steps=1. Prefill rows return their last-position sample as a
+            separate [2, Rp] buffer (token row; logprob-bits row) — the
+            chunked-prefill harvest uses it only for rows whose chunk
+            completes the prompt, mirroring ``_advance_group``."""
+            qb = pf_tokens.shape[1]
+            b = lengths.shape[0]
+            # decode rows: fresh token = last sampled, at position length.
+            # Inactive slots are inert (q_len=0, ctx=0): the kernel zeroes
+            # their output and writes no KV.
+            tokens = jnp.zeros((b, qb), jnp.int32).at[:, 0].set(last_tokens)
+            tokens = jnp.concatenate([tokens, pf_tokens], axis=0)
+            ctx = jnp.concatenate(
+                [jnp.where(active, lengths, 0), pf_ctx], axis=0)
+            qlens = jnp.concatenate(
+                [active.astype(jnp.int32), pf_qlens], axis=0)
+            table = jnp.concatenate([page_table, pf_tables], axis=0)
+            hidden, kp, vp = forward_mixed_step(
+                spec_, params, tokens, ctx, qlens, kp, vp, table,
+                attn_impl=self.attn_impl)
+            logits = unembed(spec_, params, hidden)
+            k1, k2 = jax.random.split(key)
+            next_tok, lp = sample_tokens_with_logprobs(
+                logits[:b], sampling, k1)
+            pf_tok, pf_lp = sample_tokens_with_logprobs(
+                logits[b:], pf_sampling, k2)
+            # one step of _decode_chunk's `advance` bookkeeping (kept in
+            # lockstep by the engine-equivalence test)
+            was_active = active
+            produced = produced + was_active.astype(jnp.int32)
+            hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
+            new_len = lengths + was_active.astype(jnp.int32)
+            done = hit_eos | (produced >= max_new) | (new_len >= cap)
+            active = was_active & ~done
+            last = jnp.where(was_active, next_tok, last_tokens)
+            emitted = jnp.where(was_active, next_tok, -1)
+            lp = jnp.where(was_active, lp, 0.0)
+            packed = jnp.concatenate(
+                [emitted[None],
+                 jax.lax.bitcast_convert_type(lp, jnp.int32)[None],
+                 active[None].astype(jnp.int32), new_len[None], firsts],
+                axis=0)
+            pf_first = jnp.stack(
+                [pf_tok, jax.lax.bitcast_convert_type(pf_lp, jnp.int32)])
+            return ((kp, vp, new_len, last, active, produced), packed,
+                    pf_first)
+
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
         def _install(lengths, last, active, produced, max_new, eos,
                      temps, top_k, top_p, min_p, slots, vals):
@@ -606,6 +687,14 @@ class ContinuousEngine:
         self._prefill_pages = None if has_sp else _prefill_pages
         self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
+        self._mixed_chunk = _mixed_chunk if self._mixed else None
+        # mixed-step chunk buckets: each prefill row pads its suffix to one
+        # of these (the ragged kernel's max_q); short tails reuse the
+        # smaller prefill buckets instead of always padding to the full
+        # chunk
+        self._mixed_q_buckets = (sorted(
+            {b for b in self.prefill_buckets if b < self._chunk}
+            | {self._chunk}) if self._chunk else [1])
 
         # ---- metrics
         self.prefill_stats = LatencyStats()
@@ -622,6 +711,11 @@ class ContinuousEngine:
         self._swap_fallbacks = 0    # host budget refused a swap -> "length"
         self._steps = 0
         self._prefill_calls = 0     # batched-admission dispatches
+        self._mixed_steps = 0       # mixed ragged dispatches
+        self._mixed_prefill_tokens = 0  # prefill tokens they carried
+        # (pf-rows bucket, chunk bucket) keys actually dispatched — the
+        # compile-count guard test audits this against the bucket grids
+        self._mixed_programs: set = set()
         self._occupancy_sum = 0     # Σ live slots per step (occupancy)
         self.ttft_stats = LatencyStats()   # per-request, from submit
 
@@ -1302,6 +1396,144 @@ class ContinuousEngine:
                                            len(prog.prompt), first))
         self._install_device(rows)
 
+    # -------------------------------------------------------- mixed step
+
+    def _step_mixed(self) -> None:
+        """One MIXED engine iteration (``attn_impl="pallas-ragged"`` with
+        chunked prefills in flight): active decode slots and pending
+        ``_PrefillProgress`` chunks run through ONE ``_mixed_chunk``
+        dispatch instead of the alternating ``_advance_chunked()`` +
+        decode-chunk pair — decode advances exactly one token while
+        prefill chunks ride in its bandwidth shadow (ISSUE 3 / Sarathi).
+
+        ``config.mixed_step_tokens`` caps the PREFILL tokens packed per
+        step at row granularity (oldest progress first, always at least
+        one row) so a burst of long prompts throttles to leftover compute
+        instead of monopolising the dispatch. The mixed path always
+        processes its packed output synchronously — at one decode token
+        per dispatch there is no chunk-deep pipeline for ``defer_sync``
+        to overlap, so a pending deferred chunk from a preceding
+        pure-decode step is flushed first."""
+        t0 = time.perf_counter()
+        if self._pending is not None:
+            # selection + capacity below need CURRENT host state
+            prev, self._pending = self._pending, None
+            self._process_packed(*prev)
+
+        # --- select prefill rows FIFO under the token budget
+        budget = int(getattr(self.config, "mixed_step_tokens", 0) or 0)
+        sel: List[Tuple[int, _PrefillProgress, List[int]]] = []
+        spent = 0
+        for slot, prog in self._prefilling.items():
+            sfx = prog.prompt[prog.done: prog.done + self._chunk]
+            if budget and sel and spent + len(sfx) > budget:
+                break
+            sel.append((slot, prog, sfx))
+            spent += len(sfx)
+
+        # --- decode capacity: one more token of page backing per active
+        # slot (the mixed program advances exactly one step)
+        retired: List[int] = []
+        for slot in list(self._slots):
+            state = self._slots.get(slot)
+            if state is None:
+                continue
+            cur = int(self._lengths_host[slot])
+            cap_tok = self.kv.ensure_capacity(slot, cur + 1)
+            if cap_tok <= cur:
+                if self._try_swap_out(slot):
+                    retired.append(slot)       # deactivate, no finish
+                else:
+                    self._capacity_finishes += 1
+                    retired.append(slot)
+                    self._finish(slot, "length")
+        self._deactivate_many(retired)
+
+        # --- prefill rows: the ragged kernel's epilogue DMAs each row's
+        # fresh KV straight into its pages, so the backing must cover the
+        # chunk BEFORE dispatch (admission reserved the prompt's pages;
+        # ensure_backed turns a violated reservation into a loud error
+        # instead of silent pool corruption)
+        for slot, prog, sfx in sel:
+            self.kv.ensure_capacity(slot, prog.done + len(sfx))
+            self.kv.ensure_backed(slot, prog.done + len(sfx))
+
+        n = len(sel)                           # >= 1: caller checked
+        rpb = 1 << (n - 1).bit_length() if n > 1 else 1
+        qb = _next_bucket(max(len(s) for _, _, s in sel),
+                          self._mixed_q_buckets)
+        self._mixed_programs.add((rpb, qb))
+        mp = self.kv.max_pages_per_seq
+        pf_tokens = np.zeros((rpb, qb), np.int32)
+        pf_ctx = np.zeros((rpb,), np.int32)
+        pf_qlens = np.zeros((rpb,), np.int32)   # pad rows q_len=0: inert
+        pf_tables = np.zeros((rpb, mp), np.int32)
+        temps = np.zeros((rpb,), np.float32)
+        top_k = np.zeros((rpb,), np.int32)
+        top_p = np.ones((rpb,), np.float32)
+        min_p = np.zeros((rpb,), np.float32)
+        for i, (slot, prog, sfx) in enumerate(sel):
+            pf_tokens[i, : len(sfx)] = sfx
+            pf_ctx[i] = prog.done
+            pf_qlens[i] = len(sfx)
+            pf_tables[i] = self.kv._table[slot]
+            req = prog.request
+            temps[i] = req.temperature
+            top_k[i] = req.top_k
+            top_p[i] = req.top_p
+            min_p[i] = req.min_p
+        pf_sampling = SamplingParams(
+            jnp.asarray(temps), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(min_p))
+
+        self._steps += 1
+        self._mixed_steps += 1
+        self._mixed_prefill_tokens += spent
+        self._occupancy_sum += len(self._slots)
+        cap_list = [min(self.kv.slot_capacity(s), self.max_seq_len)
+                    if s in self._slots else 0
+                    for s in range(self.max_slots)]
+        cap = jnp.asarray(cap_list, jnp.int32)
+        sampling = SamplingParams(self._temps, self._top_k, self._top_p,
+                                  self._min_p)
+        self._rng, kc = jax.random.split(self._rng)
+        self.kv.sync_tiers()
+        carry, packed, pf_first = self._mixed_chunk(
+            self.params, self.kv.k_pages, self.kv.v_pages,
+            self._lengths, self._last, self._active, self._produced,
+            self.kv.page_table, cap, self._max_new, sampling, self._eos,
+            self._firsts_dev, jnp.asarray(pf_tokens), jnp.asarray(pf_ctx),
+            jnp.asarray(pf_qlens), jnp.asarray(pf_tables), pf_sampling, kc,
+        )
+        kp, vp, self._lengths, self._last, self._active, self._produced = \
+            carry
+        self.kv.swap(kp, vp)
+        self._process_packed(packed, 1, dict(self._slots), t0, cap_list)
+
+        # --- prefill bookkeeping, mirroring _advance_group: only the LAST
+        # chunk's sample is the real first token
+        fp = None                     # read back only if someone finished
+        rows: List[Dict[str, Any]] = []
+        for i, (slot, prog, sfx) in enumerate(sel):
+            prog.done += len(sfx)
+            if prog.done < len(prog.prompt):
+                continue
+            del self._prefilling[slot]
+            if self.prefix_cache:
+                self.kv.register_prefix(slot, prog.prompt)
+            self._total_prompt_tokens += len(prog.prompt)
+            if fp is None:
+                fp = np.asarray(pf_first)     # [2, rpb]: token; lp bits
+            first = int(fp[0, i])
+            first_lp = float(fp[1].view(np.float32)[i])
+            if self._register_slot_host(prog.request, slot,
+                                        len(prog.prompt), first,
+                                        prog.t_submit, prog.on_tokens,
+                                        first_lp=first_lp):
+                rows.append(self._slot_row(prog.request, slot,
+                                           len(prog.prompt), first))
+        self._install_device(rows)
+
     # ---------------------------------------------------------- streaming
 
     def _emit_stream(self, state: _Slot) -> None:
@@ -1493,8 +1725,17 @@ class ContinuousEngine:
         iteration. With ``defer_sync``, chunk k's packed output is read
         after dispatching chunk k+1 (the round trip overlaps device
         compute); host bookkeeping — finishes, host-side stops, streaming
-        — runs one chunk behind the device."""
+        — runs one chunk behind the device.
+
+        Under ``attn_impl="pallas-ragged"`` with chunked prefills in
+        flight, the step routes to ``_step_mixed`` instead: prefill
+        chunks and decode share one ragged dispatch rather than
+        alternating."""
         self._try_admit()
+        if self._mixed and self._prefilling:
+            self._step_mixed()
+            return (len(self._slots) + len(self._prefilling)
+                    + len(self._swapped))
         self._advance_chunked()
         if not self._slots:
             # drop a stale deferred chunk: when processing chunk N frees
@@ -1870,6 +2111,9 @@ class ContinuousEngine:
             "capacity_finishes": self._capacity_finishes,
             "engine_steps": self._steps,
             "prefill_calls": self._prefill_calls,
+            "mixed_steps": self._mixed_steps,
+            "mixed_prefill_tokens": self._mixed_prefill_tokens,
+            "mixed_programs": len(self._mixed_programs),
             "prefix_hit_admissions": self._prefix_hit_admissions,
             "prefilling_slots": len(self._prefilling),
             "chunked_admissions": self._chunked_admissions,

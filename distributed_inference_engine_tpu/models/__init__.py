@@ -87,6 +87,13 @@ def engine_from_config(cfg):
     tp = int(cfg.metadata.get("tp", 1))
     sp = int(cfg.metadata.get("sp", 1))
     dp = int(cfg.metadata.get("dp", 1))
+    # sp + chunked prefill compose poorly — reject the pair here, before
+    # the checkpoint load, with the same actionable message the engine
+    # raises (config.validate_prefill_compose)
+    from ..config import validate_prefill_compose
+
+    validate_prefill_compose(
+        int(cfg.metadata.get("prefill_chunk", 0) or 0), sp=sp)
     want_mesh = tp > 1 or sp > 1 or dp > 1
     if want_mesh:
         import jax as _jax
@@ -184,7 +191,7 @@ def engine_from_config(cfg):
               "attention_impl", "kv_dtype", "prefill_buckets",
               "prefix_cache", "prefill_chunk", "decode_mode",
               "max_waiting", "queue_deadline_s",
-              "kv_offload", "kv_offload_bytes"):
+              "kv_offload", "kv_offload_bytes", "mixed_step_tokens"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

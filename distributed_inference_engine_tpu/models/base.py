@@ -475,6 +475,126 @@ def forward_prefill_suffix(
     return x, ks, vs
 
 
+def forward_mixed_step(
+    spec: ModelSpec,
+    params: Params,
+    tokens: jnp.ndarray,      # [R, Qm] per-row fresh tokens (right-padded)
+    ctx_lens: jnp.ndarray,    # [R] tokens already in the row's pages
+    q_lens: jnp.ndarray,      # [R] 0 = inert row, 1 = decode, >1 = chunk
+    k_pages: jnp.ndarray,     # [L, N, P, Hkv*Dh] paged pools — DONATED
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [R, MP] int32
+    *,
+    attn_impl: str = "xla",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE ragged mixed-batch step: decode rows (one token) and prefill-
+    chunk rows (many tokens) share a single forward against the paged
+    pools, and every row's fresh K/V lands in its reserved pages
+    (``ops/ragged_attention.py``). This is the program behind the
+    continuous engine's unified ``step()`` — prefill chunks ride in the
+    decode dispatch instead of preempting it.
+
+    Row r's token i sits at absolute position ``ctx_lens[r] + i``; rows
+    ``i >= q_lens[r]`` are padding. Returns (last hidden [R, D] — the
+    hidden at each row's LAST valid token, i.e. the next-token state —
+    plus the updated pools). Rows with ``q_lens == 0`` return garbage
+    hidden; callers mask them (the engine's ``active`` lattice).
+
+    The pallas path streams context pages per layer inside the kernel
+    (stacked-pool ``layer=l`` calls, flat [L*N, P, fused] carry); the xla
+    path gathers the whole table per layer and scatters fresh K/V with
+    the absolute-sentinel drop trick (``forward_prefill_into_pages``).
+    Both round-trip fresh K/V through the pool dtype before attending so
+    they agree bit-for-bit on what the pages hold.
+    """
+    from ..ops.ragged_attention import ragged_attention
+
+    if spec.sliding_window:
+        raise ValueError(
+            "forward_mixed_step does not support sliding-window specs "
+            "(the ragged kernel has no window mask); use the split "
+            "prefill/decode path")
+    b, qm = tokens.shape
+    L = spec.n_layers
+    n, p = k_pages.shape[1], k_pages.shape[2]
+    fused = spec.n_kv_heads * spec.head_dim
+    mp = page_table.shape[1]
+    ctx_lens = ctx_lens.astype(jnp.int32)
+    q_lens = q_lens.astype(jnp.int32)
+    positions = ctx_lens[:, None] + jnp.arange(qm)[None, :]
+    x = embed(spec, params, tokens, positions)
+    xs_blocks, rebuild = split_indexed_blocks(params["blocks"])
+
+    if attn_impl.startswith("pallas-ragged"):
+        kp_flat = k_pages.reshape(L * n, p, fused)
+        vp_flat = v_pages.reshape(L * n, p, fused)
+
+        def body(carry, per_layer):
+            x, kpf, vpf = carry
+            xs_blk, l = per_layer
+            blk = rebuild(xs_blk, l)
+            h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+            q, k, v = _qkv(spec, blk, h, positions)
+            attn, kpf, vpf = ragged_attention(
+                q, kpf, vpf, page_table, ctx_lens, q_lens, k, v,
+                n_kv_heads=spec.n_kv_heads, impl=attn_impl,
+                layer=l, n_pages_per_layer=n)
+            x = x + _out_proj(spec, blk, attn)
+            h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+            m, _ = _mlp(spec, blk, h2)
+            return (x + m, kpf, vpf), None
+
+        (x, kp_flat, vp_flat), _ = lax.scan(
+            body, (x, kp_flat, vp_flat), (xs_blocks, jnp.arange(L)))
+        k_pages = kp_flat.reshape(L, n, p, fused)
+        v_pages = vp_flat.reshape(L, n, p, fused)
+    else:
+        # reference path: whole-table gather + suffix attention per layer,
+        # pools ride the carry as flat [L·N·P, fused] views
+        local = jnp.broadcast_to(jnp.arange(qm, dtype=jnp.int32)[None, :],
+                                 (b, qm))
+        q_valid = local < q_lens[:, None]
+        logical = jnp.minimum(positions // p, mp - 1)
+        phys = jnp.take_along_axis(page_table, logical, axis=1)
+        base_idx = phys * p + positions % p                    # [R, Qm]
+        gather_idx = (page_table[:, :, None] * p
+                      + jnp.arange(p)[None, None, :]).reshape(b, mp * p)
+        kp_flat = k_pages.reshape(L * n * p, fused)
+        vp_flat = v_pages.reshape(L * n * p, fused)
+
+        def body(carry, per_layer):
+            x, kpf, vpf = carry
+            xs_blk, l = per_layer
+            blk = rebuild(xs_blk, l)
+            h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+            q, k, v = _qkv(spec, blk, h, positions)
+            # pool-dtype round trip BEFORE attending (see docstring)
+            kq = k.astype(kpf.dtype)
+            vq = v.astype(vpf.dtype)
+            ck = kpf[l * (n * p) + gather_idx].reshape(
+                b, mp * p, spec.n_kv_heads, spec.head_dim)
+            cv = vpf[l * (n * p) + gather_idx].reshape(
+                b, mp * p, spec.n_kv_heads, spec.head_dim)
+            attn = suffix_attention(
+                q, ck.astype(q.dtype), cv.astype(q.dtype), ctx_lens,
+                kq.astype(q.dtype), vq.astype(q.dtype), q_lens)
+            x = x + _out_proj(spec, blk, attn)
+            h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+            m, _ = _mlp(spec, blk, h2)
+            idx = jnp.where(q_valid, l * (n * p) + base_idx, L * n * p)
+            kpf = kpf.at[idx].set(kq.reshape(b, qm, fused), mode="drop")
+            vpf = vpf.at[idx].set(vq.reshape(b, qm, fused), mode="drop")
+            return (x + m, kpf, vpf), None
+
+        (x, kp_flat, vp_flat), _ = lax.scan(
+            body, (x, kp_flat, vp_flat), (xs_blocks, jnp.arange(L)))
+        k_pages = kp_flat.reshape(L, n, p, fused)
+        v_pages = vp_flat.reshape(L, n, p, fused)
+
+    last = x[jnp.arange(b), jnp.maximum(q_lens - 1, 0)]        # [R, D]
+    return last, k_pages, v_pages
+
+
 def forward_window(
     spec: ModelSpec,
     params: Params,

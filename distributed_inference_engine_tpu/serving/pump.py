@@ -42,10 +42,18 @@ class EnginePump:
     ``generate`` joins requests into the rolling batch."""
 
     def __init__(self, engine: Any, idle_wait_s: float = 0.25,
-                 error_backoff_s: float = 0.05) -> None:
+                 error_backoff_s: float = 0.05,
+                 mixed_step_tokens: Optional[int] = None) -> None:
         self.engine = engine
         self.idle_wait_s = idle_wait_s          # safety-net poll when idle
         self.error_backoff_s = error_backoff_s  # pause after a failed step
+        if mixed_step_tokens is not None:
+            # serving-layer Sarathi knob (BatcherConfig.mixed_step_tokens):
+            # cap the prefill tokens each mixed ragged step carries so
+            # admission bursts throttle to leftover compute instead of
+            # stretching live decodes' inter-token latency. Hand down into
+            # the engine config — only the engine's _step_mixed reads it.
+            engine.config.mixed_step_tokens = int(mixed_step_tokens)
         # (request, optional handoff, optional stream cb, future, loop)
         self._inbox: List[Tuple[GenerationRequest, Any, Any, asyncio.Future,
                                 asyncio.AbstractEventLoop]] = []

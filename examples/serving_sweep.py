@@ -19,6 +19,7 @@ Usage (defaults mirror bench.py serving mode at the 8B rung):
     SWEEP_RATES=4,8,12 SWEEP_REQUESTS=96 SWEEP_TRIALS=5 \
         python examples/serving_sweep.py
     SWEEP_SHAPE=long python examples/serving_sweep.py   # 2k-prompt rung
+    SWEEP_SHAPE=mixed python examples/serving_sweep.py  # ragged mixed rung
 Prints one JSON line per rate (the median trial, annotated with the
 band) and a final markdown table on stderr.
 """
@@ -47,6 +48,23 @@ if os.environ.get("SWEEP_SHAPE", "") == "long":
     os.environ.setdefault("BENCH_PREFILL_CHUNK", "512")
     os.environ.setdefault("BENCH_KV_DTYPE", "float8_e4m3fn")
     os.environ.setdefault("BENCH_KV_OFFLOAD", "1")
+# SWEEP_SHAPE=mixed (ISSUE 3): a steady 128-token decode stream with every
+# 8th request admitting a 2k-token prompt — the workload whose decode ITL
+# p99 the ragged mixed step must keep from cliffing during admissions
+# (acceptance: no step past ~2x the steady-state ITL median). Runs the
+# ragged kernel with chunked prefill and a Sarathi-style per-step prefill
+# budget; compare against BENCH_ATTN=xla (alternating dispatch) to see the
+# cliff this shape exists to measure. fp8 KV for the same capacity reason
+# as the long rung.
+if os.environ.get("SWEEP_SHAPE", "") == "mixed":
+    os.environ.setdefault("BENCH_PROMPT", "128")
+    os.environ.setdefault("BENCH_NEW_TOKENS", "128")
+    os.environ.setdefault("BENCH_MIX_EVERY", "8")
+    os.environ.setdefault("BENCH_MIX_PROMPT", "2048")
+    os.environ.setdefault("BENCH_PREFILL_CHUNK", "512")
+    os.environ.setdefault("BENCH_MIXED_TOKENS", "512")
+    os.environ.setdefault("BENCH_ATTN", "pallas-ragged")
+    os.environ.setdefault("BENCH_KV_DTYPE", "float8_e4m3fn")
 
 import numpy as np  # noqa: E402
 
@@ -107,6 +125,7 @@ async def run_rate(pump, spec, rate, n_requests, seed):
         "rejection_rate": round(rejected[0] / len(reqs), 3),
         "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 1),
         "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 1),
+        "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
         "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
         "occupancy": round(occ, 3),
         "wall_s": round(wall, 1),
@@ -172,15 +191,16 @@ def main():
     asyncio.run(pump.stop())
 
     log("\n| offered req/s | goodput tok/s (median) | band | served | "
-        "rejected | TTFT p50 | TTFT p99 | ITL p99 | occupancy |")
-    log("|---|---|---|---|---|---|---|---|---|")
+        "rejected | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 | occupancy |")
+    log("|---|---|---|---|---|---|---|---|---|---|")
     for r in rows:
         lo, hi = r["goodput_band"]
         log(f"| {r['rate']:g} | {r['goodput_toks']} | {lo:g}–{hi:g} | "
             f"{r['served']} | "
             f"{r['rejected']} ({r['rejection_rate']:.0%}) | "
             f"{r['ttft_p50_ms']:.0f} ms | {r['ttft_p99_ms']:.0f} ms | "
-            f"{r['itl_p99_ms']:.1f} ms | {r['occupancy']:.2f} |")
+            f"{r['itl_p50_ms']:.1f} ms | {r['itl_p99_ms']:.1f} ms | "
+            f"{r['occupancy']:.2f} |")
 
 
 if __name__ == "__main__":

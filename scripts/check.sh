@@ -34,7 +34,19 @@ if [ "${SKIP_TESTS:-0}" = "1" ]; then
     exit 0
 fi
 
-# --- stage 2: tier-1 tests (verbatim ROADMAP.md verify command) -------
+# --- stage 2: fast kernel-parity leg ----------------------------------
+# Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
+# fails here in seconds instead of minutes into the full tier-1 sweep.
+echo "== kernel parity (-m 'kernels and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'kernels and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: kernel parity leg FAILED" >&2
+    exit "$rc"
+fi
+
+# --- stage 3: tier-1 tests (verbatim ROADMAP.md verify command) -------
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

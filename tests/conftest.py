@@ -47,6 +47,9 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run the test in an event loop")
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "kernels: Pallas kernel parity tests (fast standalone "
+        "leg: pytest -m 'kernels and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -19,6 +19,8 @@ from distributed_inference_engine_tpu.ops.flash_decode import (
 
 IMPL = "pallas-decode_interpret"
 
+pytestmark = pytest.mark.kernels
+
 
 def _inputs(key, *, b=4, h=4, hkv=2, dh=64, n=16, p=8, mp=3, w=5,
             layers=1, q_dtype=jnp.float32, kv_dtype=jnp.float32,

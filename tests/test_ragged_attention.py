@@ -1,0 +1,317 @@
+"""Ragged mixed-batch attention (ops/ragged_attention.py): parity of the
+Pallas kernel (CPU interpret mode) against the XLA reference — decode rows
+(q=1), prefill-chunk rows (q>1), and inert rows (q=0) in ONE dispatch —
+across GQA groupings, fp8 pools, masked tails, page-boundary-straddling
+chunks, and stacked-pool layer indexing; fresh-KV page writeback must be
+bit-exact. Plus forward_mixed_step wiring, the config compose-validation
+error, the compile-count guard (bucket audit), and engine-level greedy
+equivalence of the mixed step vs the alternating split path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.ops.ragged_attention import (
+    ragged_attention,
+    ragged_attention_pallas,
+    ragged_attention_xla,
+)
+
+IMPL = "pallas-ragged_interpret"
+
+pytestmark = pytest.mark.kernels
+
+
+def _inputs(key, *, r=4, qmax=8, h=4, hkv=2, dh=64, n=32, p=8, mp=4,
+            layers=1, q_dtype=jnp.float32, kv_dtype=jnp.float32,
+            ctx_lens=None, q_lens=None):
+    """Random mixed batch. Rows own DISJOINT page sets (the engine
+    invariant the kernel's writeback relies on); ctx+q stays within each
+    row's mp pages."""
+    assert r * mp <= n
+    ks = jax.random.split(key, 6)
+    q = jax.random.normal(ks[0], (r, qmax, h, dh), q_dtype)
+    kp = jax.random.normal(ks[1], (layers * n, p, hkv * dh),
+                           jnp.float32).astype(kv_dtype)
+    vp = jax.random.normal(ks[2], (layers * n, p, hkv * dh),
+                           jnp.float32).astype(kv_dtype)
+    perm = jax.random.permutation(ks[3], n)[: r * mp]
+    pt = perm.reshape(r, mp).astype(jnp.int32)
+    fk = jax.random.normal(ks[4], (r, qmax, hkv, dh), jnp.float32)
+    fv = jax.random.normal(ks[5], (r, qmax, hkv, dh), jnp.float32)
+    if ctx_lens is None:
+        # page-straddling, non-aligned contexts by construction
+        ctx_lens = [(3 + 5 * i) % (mp * p - qmax) for i in range(r)]
+    if q_lens is None:
+        # the mixed shape: decode row, chunk rows, full row
+        q_lens = [1 if i == 0 else min(qmax, 2 + 3 * i) for i in range(r)]
+    return (q, kp, vp, pt, jnp.asarray(ctx_lens, jnp.int32),
+            jnp.asarray(q_lens, jnp.int32), fk, fv)
+
+
+def _assert_match(got, want, tol):
+    out, kp, vp = got
+    out_r, kp_r, vp_r = want
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(out_r, np.float32),
+        rtol=tol, atol=tol)
+    # writeback is the SAME cast bits to the SAME slots: bit-exact
+    np.testing.assert_array_equal(np.asarray(kp).view(np.uint8),
+                                  np.asarray(kp_r).view(np.uint8))
+    np.testing.assert_array_equal(np.asarray(vp).view(np.uint8),
+                                  np.asarray(vp_r).view(np.uint8))
+
+
+# ------------------------------------------------------ kernel-level parity
+
+
+@pytest.mark.parametrize("h,hkv,dh", [(4, 4, 64), (4, 2, 64), (8, 2, 64)])
+def test_parity_gqa(h, hkv, dh):
+    args = _inputs(jax.random.key(0), h=h, hkv=hkv, dh=dh)
+    ref = ragged_attention_xla(*args, n_kv_heads=hkv)
+    got = ragged_attention_pallas(*args, n_kv_heads=hkv, interpret=True)
+    _assert_match(got, ref, 2e-5)
+
+
+@pytest.mark.parametrize("kv_dtype,tol", [
+    (jnp.bfloat16, 2e-2),
+    (jnp.float8_e4m3fn, 8e-2),
+])
+def test_parity_low_precision_pools(kv_dtype, tol):
+    args = _inputs(jax.random.key(1), kv_dtype=kv_dtype)
+    ref = ragged_attention_xla(*args, n_kv_heads=2)
+    got = ragged_attention_pallas(*args, n_kv_heads=2, interpret=True)
+    _assert_match(got, ref, tol)
+
+
+def test_parity_empty_and_masked_rows():
+    """q_len=0 rows are inert (zero output, no writeback); q_len<qmax rows
+    mask their tail queries and write only q_len fresh tokens."""
+    args = _inputs(jax.random.key(2), r=4, qmax=8,
+                   ctx_lens=[0, 5, 16, 23], q_lens=[0, 1, 8, 3])
+    ref = ragged_attention_xla(*args, n_kv_heads=2)
+    got = ragged_attention_pallas(*args, n_kv_heads=2, interpret=True)
+    _assert_match(got, ref, 2e-5)
+    # inert row's output really is zero
+    np.testing.assert_array_equal(np.asarray(got[0][0]), 0.0)
+
+
+def test_parity_page_straddling_chunks():
+    """Fresh chunks whose [ctx, ctx+q) span crosses a page boundary land
+    split across two physical pages."""
+    # p=8: ctx=6 with q=8 straddles page 0->1; ctx=13 straddles 1->2
+    args = _inputs(jax.random.key(3), r=3, qmax=8, p=8, mp=4,
+                   ctx_lens=[6, 13, 21], q_lens=[8, 8, 8])
+    ref = ragged_attention_xla(*args, n_kv_heads=2)
+    got = ragged_attention_pallas(*args, n_kv_heads=2, interpret=True)
+    _assert_match(got, ref, 2e-5)
+
+
+def test_parity_decode_only_and_prefill_only():
+    """The ragged kernel degenerates correctly at both ends of the mix."""
+    for q_lens in ([1, 1, 1, 1], [8, 8, 8, 8]):
+        args = _inputs(jax.random.key(4), q_lens=q_lens)
+        ref = ragged_attention_xla(*args, n_kv_heads=2)
+        got = ragged_attention_pallas(*args, n_kv_heads=2, interpret=True)
+        _assert_match(got, ref, 2e-5)
+
+
+def test_stacked_layer_pools():
+    """layer=1 of 2: the kernel offsets into the stacked pool and leaves
+    layer 0 untouched."""
+    layers, n = 2, 32
+    (q, kp, vp, pt, ctx, qlens, fk, fv) = _inputs(
+        jax.random.key(5), n=n, layers=layers)
+    kp0 = np.asarray(kp).copy()
+    vp0 = np.asarray(vp).copy()
+    ref = ragged_attention_xla(q, kp[n:], vp[n:], pt, ctx, qlens, fk, fv,
+                               n_kv_heads=2)
+    out, kp2, vp2 = ragged_attention_pallas(
+        q, kp, vp, pt, ctx, qlens, fk, fv, n_kv_heads=2, interpret=True,
+        layer=jnp.int32(1), n_pages_per_layer=n)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref[0]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(kp2[n:]), np.asarray(ref[1]))
+    np.testing.assert_array_equal(np.asarray(vp2[n:]), np.asarray(ref[2]))
+    # layer 0 pools untouched
+    np.testing.assert_array_equal(np.asarray(kp2[:n]), kp0[:n])
+    np.testing.assert_array_equal(np.asarray(vp2[:n]), vp0[:n])
+
+
+def test_dispatcher():
+    args = _inputs(jax.random.key(6))
+    ref = ragged_attention(*args, n_kv_heads=2, impl="xla")
+    got = ragged_attention(*args, n_kv_heads=2, impl=IMPL)
+    _assert_match(got, ref, 2e-5)
+    with pytest.raises(ValueError, match="unknown ragged attention impl"):
+        ragged_attention(*args, n_kv_heads=2, impl="nope")
+
+
+# ------------------------------------------------------- model-level wiring
+
+
+def _tiny_spec():
+    from distributed_inference_engine_tpu.models.base import ModelSpec
+
+    return ModelSpec(
+        vocab_size=256, d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_seq_len=128, dtype="float32",
+    )
+
+
+def test_forward_mixed_step_parity():
+    from distributed_inference_engine_tpu.models.base import (
+        forward_mixed_step,
+        init_params,
+    )
+
+    spec = _tiny_spec()
+    params = init_params(spec, jax.random.key(0))
+    L, n, p, mp, r, qmax = spec.n_layers, 16, 8, 4, 3, 8
+    fused = spec.n_kv_heads * spec.head_dim
+    ks = jax.random.split(jax.random.key(7), 4)
+    kp = jax.random.normal(ks[0], (L, n, p, fused), jnp.float32)
+    vp = jax.random.normal(ks[1], (L, n, p, fused), jnp.float32)
+    pt = jax.random.permutation(ks[2], n)[: r * mp].reshape(r, mp)
+    pt = pt.astype(jnp.int32)
+    tokens = jax.random.randint(ks[3], (r, qmax), 0, spec.vocab_size,
+                                jnp.int32)
+    ctx = jnp.asarray([5, 0, 17], jnp.int32)
+    qlens = jnp.asarray([1, 8, 3], jnp.int32)
+    h_ref, kp_ref, vp_ref = forward_mixed_step(
+        spec, params, tokens, ctx, qlens, kp, vp, pt, attn_impl="xla")
+    h_got, kp_got, vp_got = forward_mixed_step(
+        spec, params, tokens, ctx, qlens, kp, vp, pt, attn_impl=IMPL)
+    np.testing.assert_allclose(np.asarray(h_got), np.asarray(h_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(kp_got), np.asarray(kp_ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vp_got), np.asarray(vp_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------- config compose validation
+
+
+def test_validate_prefill_compose():
+    from distributed_inference_engine_tpu.config import (
+        validate_prefill_compose,
+    )
+
+    validate_prefill_compose(0, sp=4)        # no chunking: any sp is fine
+    validate_prefill_compose(512, sp=1)      # chunking without sp is fine
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        validate_prefill_compose(512, sp=2)
+    # the message must be actionable: name both escape hatches
+    with pytest.raises(ValueError, match="prefill_chunk=0"):
+        validate_prefill_compose(512, sp=2)
+    with pytest.raises(ValueError, match="sp=1"):
+        validate_prefill_compose(512, sp=2)
+
+
+def test_metadata_loader_rejects_sp_plus_chunk():
+    """The deploy-config path fails BEFORE the checkpoint load."""
+    from distributed_inference_engine_tpu.config import ModelConfig
+    from distributed_inference_engine_tpu.models import engine_from_config
+
+    cfg = ModelConfig(
+        name="m", architecture="gpt2", metadata={
+            "sp": 2, "prefill_chunk": 512})
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        engine_from_config(cfg)
+
+
+def test_ragged_rejects_sliding_window_spec():
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+
+    spec = _tiny_spec().replace(sliding_window=16)
+    with pytest.raises(ValueError, match="sliding-window"):
+        ContinuousEngine(spec, config=EngineConfig(
+            attention_impl="pallas-ragged", max_slots=2, max_seq_len=64,
+            prefill_buckets=[16], page_size=16, num_pages=16), seed=0)
+
+
+# ------------------------------------------------------------- engine level
+
+
+def _mk_engines(extra=None, both=True):
+    from distributed_inference_engine_tpu.config import EngineConfig
+    from distributed_inference_engine_tpu.engine.continuous import (
+        ContinuousEngine,
+    )
+
+    spec = _tiny_spec()
+    base = dict(max_slots=2, max_seq_len=64, prefill_buckets=[16],
+                page_size=16, num_pages=16, decode_steps_per_call=4,
+                prefill_chunk=16)
+    base.update(extra or {})
+    xla = ContinuousEngine(spec, config=EngineConfig(
+        attention_impl="xla", **base), seed=0)
+    if not both:
+        return xla, None
+    rg = ContinuousEngine(spec, params=xla.params, config=EngineConfig(
+        attention_impl=IMPL, **base), seed=0)
+    return xla, rg
+
+
+def _reqs():
+    from distributed_inference_engine_tpu.engine.types import (
+        GenerationRequest,
+    )
+
+    return [GenerationRequest(
+        prompt=[(3 * i + j) % 250 + 1 for j in range(28)],
+        max_new_tokens=8, temperature=0.0, request_id=f"long{i}")
+        for i in range(2)] + [GenerationRequest(
+            prompt=[5, 9, 13], max_new_tokens=8, temperature=0.0,
+            request_id="short")]
+
+
+@pytest.mark.slow
+def test_engine_mixed_greedy_equivalence():
+    """attn_impl="pallas-ragged_interpret" + chunked prefill: greedy
+    output token-for-token identical to the split (alternating) xla
+    path, and the mixed dispatch actually engaged."""
+    xla, rg = _mk_engines()
+    a = {r.request_id: r.tokens for r in xla.generate(_reqs())}
+    b = {r.request_id: r.tokens for r in rg.generate(_reqs())}
+    assert a == b
+    m = rg.get_metrics()
+    assert m["mixed_steps"] > 0
+    assert m["mixed_prefill_tokens"] > 0
+    assert xla.get_metrics()["mixed_steps"] == 0
+
+
+@pytest.mark.slow
+def test_engine_mixed_step_token_budget():
+    """mixed_step_tokens throttles prefill rows per step (row-granular,
+    always >= 1) without changing greedy output."""
+    xla, rg = _mk_engines(extra=dict(mixed_step_tokens=12))
+    a = {r.request_id: r.tokens for r in xla.generate(_reqs())}
+    b = {r.request_id: r.tokens for r in rg.generate(_reqs())}
+    assert a == b
+    m = rg.get_metrics()
+    # two 12-token tails at a 12-token budget: one row per step, so the
+    # budget forces at least two mixed steps
+    assert m["mixed_steps"] >= 2
+
+
+@pytest.mark.slow
+def test_engine_compile_count_guard():
+    """Bucket audit: a mixed-workload run dispatches a BOUNDED set of
+    (prefill-rows bucket, chunk bucket) programs — the jit cache cannot
+    grow with the workload."""
+    _, rg = _mk_engines()
+    assert rg is not None
+    rg.generate(_reqs())
+    rg.generate(_reqs())               # second wave: no new buckets
+    m = rg.get_metrics()
+    row_buckets = rg.max_slots.bit_length() + 1   # pow2 row counts
+    bound = row_buckets * len(rg._mixed_q_buckets)
+    assert 0 < m["mixed_programs"] <= bound
+    # and the audit set is the jit-program key set, not a step counter
+    assert m["mixed_programs"] <= m["mixed_steps"]
