@@ -358,6 +358,57 @@ def _requests(spec, seed: int, n: int):
     ]
 
 
+def dump_obs(engine, result_rows, label, pump=None) -> None:
+    """Drop a /metrics-equivalent registry snapshot, a per-request trace
+    JSONL, and the engine's step timeline (Perfetto-loadable) next to the
+    BENCH json. ``BENCH_OBS_DIR`` picks the directory (default bench_obs;
+    "0" disables)."""
+    out_dir = os.environ.get("BENCH_OBS_DIR", "bench_obs")
+    if out_dir in ("0", ""):
+        return
+    try:
+        from distributed_inference_engine_tpu.obs import (
+            collectors as obs_collectors,
+        )
+        from distributed_inference_engine_tpu.obs.registry import (
+            MetricsRegistry,
+        )
+
+        os.makedirs(out_dir, exist_ok=True)
+        reg = MetricsRegistry()
+        obs_collectors.ensure_families(reg)
+        obs_collectors.apply_engine(reg, engine.get_metrics(),
+                                    model=MODEL, worker_id="bench")
+        if pump is not None:
+            ps = {k: v for k, v in pump.get_stats().items()
+                  if k != "engine"}
+            obs_collectors.apply_pump(reg, ps, model=MODEL,
+                                      worker_id="bench")
+        with open(os.path.join(out_dir, f"bench_metrics_{label}.prom"),
+                  "w") as f:
+            f.write(reg.render())
+        with open(os.path.join(out_dir, f"bench_traces_{label}.jsonl"),
+                  "w") as f:
+            for row in result_rows:
+                f.write(json.dumps(row) + "\n")
+        tl = getattr(engine, "timeline", None)
+        if tl is not None and len(tl):
+            tl.dump(os.path.join(out_dir, f"bench_timeline_{label}.json"))
+        log(f"obs dump -> {out_dir}/bench_*_{label}.*")
+    except Exception as e:             # observability must not fail the rung
+        log(f"obs dump failed: {e}")
+
+
+def _result_row(res) -> dict:
+    return {
+        "request_id": res.request_id,
+        "tokens": len(res.tokens),
+        "finish_reason": res.finish_reason,
+        "ttft_s": round(float(res.ttft_s), 6),
+        "decode_s": round(float(res.decode_s), 6),
+    }
+
+
 def decode_main() -> None:
     """Batch-decode throughput rung (static or continuous engine)."""
     spec = _spec()
@@ -438,6 +489,7 @@ def decode_main() -> None:
         row.pop("hbm_util", None)
         row.pop("achieved_gbps", None)
     print(json.dumps(row), flush=True)
+    dump_obs(engine, [_result_row(x) for x in results], "decode")
 
 
 def serving_main() -> None:
@@ -499,6 +551,8 @@ def serving_main() -> None:
 
     rejected = [0]                     # queue-full + deadline sheds
 
+    trace_rows: list = []
+
     async def client(req):
         marks = []
 
@@ -510,6 +564,7 @@ def serving_main() -> None:
         except EngineOverloadedError:
             rejected[0] += 1
             return 0
+        trace_rows.append(_result_row(res))
         ttfts.append(res.ttft_s)
         prev = None
         for t, k in marks:
@@ -564,6 +619,7 @@ def serving_main() -> None:
         "rejected": rejected[0],
         "rejection_rate": round(rej_rate, 3),
     }), flush=True)
+    dump_obs(engine, trace_rows, "serving", pump=pump)
 
 
 def main() -> None:

@@ -28,6 +28,7 @@ import asyncio
 import copy
 import logging
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -46,6 +47,8 @@ from ..cluster.worker import (
     request_from_dict,
     result_to_dict,
 )
+from ..obs import collectors as obs_collectors
+from ..obs.registry import MetricsRegistry
 from ..serving.batcher import PAD_INPUT, Batcher
 from ..serving.cache import ResponseCache
 from ..utils.tracing import RequestTrace, new_request_id
@@ -144,6 +147,16 @@ class Coordinator:
         self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
         # disaggregated deployments: model -> (prefill worker ids, rr cursor)
         self._disagg: Dict[str, "_DisaggPool"] = {}
+        # -- observability: unified metrics + recent request traces --------
+        # the registry mirrors this process's stats dicts at scrape time;
+        # worker families come from the last best-effort fleet poll
+        # (refreshed by metrics_text)
+        self.obs_registry = MetricsRegistry()
+        obs_collectors.ensure_families(self.obs_registry)
+        self.obs_registry.add_collector(self._obs_collect)
+        self._worker_metrics: Dict[str, Dict[str, Any]] = {}
+        self._recent_traces: "OrderedDict[str, RequestTrace]" = OrderedDict()
+        self._recent_traces_cap = 256
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -350,6 +363,7 @@ class Coordinator:
                 out["request_id"] = request_id
                 out["cached"] = True
                 out["trace"] = trace.to_dict()
+                self._remember_trace(trace)
                 if tokenizer is not None:
                     # entries are cached in token space only; text is derived
                     # per-request so token- and text-mode callers can share
@@ -369,6 +383,11 @@ class Coordinator:
             "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
             "request_id": request_id,
             "key": affinity,
+            # the live trace rides the batcher input so _run_batch can mark
+            # routing/dispatch phases and merge the worker-side spans; it is
+            # a coordinator-local key — request_from_dict ignores it and it
+            # never crosses the wire
+            "trace": trace,
         }
         future = await self.batcher.add_request(
             model, version, inputs, request_id=request_id, trace=trace
@@ -387,6 +406,7 @@ class Coordinator:
                 reason=result.get("metadata", {}).get("overload_reason",
                                                       "queue_full"))
         trace.mark("done")
+        self._remember_trace(trace)
         result = dict(result)
         result["cached"] = False
         result["trace"] = trace.to_dict()
@@ -446,6 +466,7 @@ class Coordinator:
                 model, version, affinity).worker.worker_id
         else:
             worker_id = self.lb.get_worker().worker_id
+        trace.mark("routed")
 
         req = request_from_dict({
             "prompt": list(prompt), "max_new_tokens": max_new_tokens,
@@ -463,6 +484,7 @@ class Coordinator:
             delivered += len(toks)
             cb(toks)
 
+        trace.mark("dispatched")
         try:
             result = await self._stream_once(model, worker_id, req,
                                              counting_cb)
@@ -524,6 +546,8 @@ class Coordinator:
         out["cached"] = False
         out["streamed"] = True
         out["metadata"]["worker_id"] = worker_id
+        self._merge_worker_trace({"trace": trace}, out)
+        self._remember_trace(trace)
         out["trace"] = trace.to_dict()
         if tokenizer is not None:
             out["text"] = tokenizer.decode(out.get("tokens", []))
@@ -603,13 +627,18 @@ class Coordinator:
                 except Exception as e:
                     results[idx] = e
                     continue
+                self._trace_mark(inp, "routed")
                 groups.setdefault(route.worker.worker_id, []).append(idx)
         else:
             picked = self.lb.get_worker()
+            for inp in reals:
+                self._trace_mark(inp, "routed")
             groups[picked.worker_id] = list(range(len(reals)))
 
         async def run_group(worker_id: str, idxs: List[int]) -> None:
             reqs = [request_from_dict(reals[i]) for i in idxs]
+            for i in idxs:
+                self._trace_mark(reals[i], "dispatched")
             try:
                 outs = await self._dispatch_with_retry(
                     model, version, worker_id, reqs,
@@ -655,6 +684,11 @@ class Coordinator:
 
         await asyncio.gather(*(run_group(w, idxs)
                                for w, idxs in groups.items()))
+        # anchor worker-reported phase offsets onto each request's local
+        # trace timeline (after shed-retries settled, so the span set
+        # reflects the dispatch that actually produced the result)
+        for inp, out in zip(reals, results):
+            self._merge_worker_trace(inp, out)
         return results  # aligned with the real inputs, pads dropped
 
     async def _dispatch_with_retry(
@@ -969,6 +1003,78 @@ class Coordinator:
                     logger.warning("restore: redeploy of %s failed (%s) — "
                                    "continuing", name, e)
         return added
+
+    # -- request tracing ----------------------------------------------------
+
+    @staticmethod
+    def _trace_mark(inp: Any, phase: str) -> None:
+        """Mark a phase on the trace riding a batcher input, if any."""
+        if isinstance(inp, dict):
+            tr = inp.get("trace")
+            if isinstance(tr, RequestTrace):
+                tr.mark(phase)
+
+    @staticmethod
+    def _merge_worker_trace(inp: Any, out: Any) -> None:
+        """Anchor the worker-reported phase offsets (attached by the worker
+        as ``metadata['worker_trace']``) onto the request's local trace as
+        ``worker.*`` marks, pinned at the ``dispatched`` mark."""
+        if not isinstance(inp, dict) or not isinstance(out, dict):
+            return
+        tr = inp.get("trace")
+        if not isinstance(tr, RequestTrace):
+            return
+        wt = out.get("metadata", {}).get("worker_trace")
+        if isinstance(wt, dict) and isinstance(wt.get("offsets"), dict):
+            tr.add_offsets("worker.", wt["offsets"])
+
+    def _remember_trace(self, trace: RequestTrace) -> None:
+        """Retain the trace for the trace-dump endpoint (bounded LRU)."""
+        self._recent_traces[trace.request_id] = trace
+        self._recent_traces.move_to_end(trace.request_id)
+        while len(self._recent_traces) > self._recent_traces_cap:
+            self._recent_traces.popitem(last=False)
+
+    def get_trace(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The recorded trace of a recent request (coordinator marks plus
+        anchored ``worker.*`` spans), or ``None`` if it has aged out."""
+        tr = self._recent_traces.get(request_id)
+        return tr.to_dict() if tr is not None else None
+
+    # -- metrics exposition -------------------------------------------------
+
+    def _obs_collect(self) -> None:
+        """Scrape-time collector: rebuild worker-labelled series from the
+        last fleet poll, then mirror this process's stats dicts."""
+        obs_collectors.clear_worker_labelled(self.obs_registry)
+        obs_collectors.apply_coordinator(self.obs_registry, self.get_stats())
+        for wid, wm in self._worker_metrics.items():
+            obs_collectors.apply_worker(self.obs_registry, wm, worker_id=wid)
+
+    async def metrics_text(self, refresh_workers: bool = True,
+                           timeout_s: float = 2.0) -> str:
+        """The unified OpenMetrics exposition (``GET /metrics`` body).
+
+        Best-effort polls every registered worker's ``metrics`` RPC first
+        (short timeout, failures ignored — a dead worker must not fail the
+        scrape; its series simply go stale-then-cleared)."""
+        if refresh_workers:
+            wids = list(self.router.workers)
+
+            async def fetch(wid: str):
+                try:
+                    client = (self.router.client_for(wid)
+                              if wid in self.router.workers
+                              else self.lb.client_for(wid))
+                    return wid, await client.call("metrics",
+                                                  timeout=timeout_s)
+                except Exception:
+                    return wid, None
+
+            fetched = await asyncio.gather(*(fetch(w) for w in wids))
+            self._worker_metrics = {wid: wm for wid, wm in fetched
+                                    if isinstance(wm, dict)}
+        return self.obs_registry.render()
 
     # -- introspection ------------------------------------------------------
 

@@ -19,6 +19,7 @@ import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ModelConfig, ServerConfig
+from ..obs.registry import OPENMETRICS_CONTENT_TYPE
 from ..utils.rpc import FramedRPCClient, FramedServerMixin, relay_stream
 from .coordinator import Coordinator
 
@@ -43,6 +44,8 @@ class CoordinatorServer(FramedServerMixin):
             "remove_worker": self._rpc_remove_worker,
             "stats": self._rpc_stats,
             "models": self._rpc_models,
+            "metrics_text": self._rpc_metrics_text,
+            "trace": self._rpc_trace,
         }
         self._stream_methods = {
             "generate_stream": self._rpc_generate_stream,
@@ -147,6 +150,23 @@ class CoordinatorServer(FramedServerMixin):
         return {"models": {name: reg.list_versions(name)
                            for name in reg.list_models()}}
 
+    async def _rpc_metrics_text(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        text = await self.coordinator.metrics_text(
+            refresh_workers=bool(msg.get("refresh_workers", True)))
+        return {"content_type": OPENMETRICS_CONTENT_TYPE, "text": text}
+
+    async def _rpc_trace(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return {"trace": self.coordinator.get_trace(str(msg["request_id"]))}
+
+    async def _http_get(self, path: str):
+        """Plain-HTTP escape hatch on the RPC port (utils/rpc.py protocol
+        sniff): ``GET /metrics`` serves the fleet-wide OpenMetrics text so
+        a stock Prometheus can scrape the coordinator directly."""
+        if path == "/metrics":
+            text = await self.coordinator.metrics_text()
+            return (OPENMETRICS_CONTENT_TYPE, text.encode("utf-8"))
+        return None
+
 
 class CoordinatorClient(FramedRPCClient):
     """User-facing client (the README's promised ``example_client``,
@@ -187,6 +207,18 @@ class CoordinatorClient(FramedRPCClient):
 
     async def stats(self) -> Dict[str, Any]:
         return await self.call("stats")
+
+    async def metrics_text(self, refresh_workers: bool = True) -> str:
+        """The coordinator's fleet-wide OpenMetrics exposition text."""
+        result = await self.call("metrics_text",
+                                 refresh_workers=refresh_workers)
+        return str(result["text"])
+
+    async def get_trace(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """Per-phase trace of a recent request (coordinator + worker spans),
+        or ``None`` if the coordinator has aged it out."""
+        result = await self.call("trace", request_id=request_id)
+        return result.get("trace")
 
     async def ping(self) -> Dict[str, Any]:
         return await self.call("ping", timeout=5.0)

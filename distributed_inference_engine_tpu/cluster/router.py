@@ -95,6 +95,7 @@ class Router:
         self._route_count = 0
         self._failover_count = 0
         self._routing_errors = 0
+        self._routes_by_worker: Dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -164,6 +165,8 @@ class Router:
             raise RoutingError(f"no shards for {model}:{version}")
         worker = self.workers.get(shard.worker_id)
         if worker is not None and worker.health is not WorkerHealth.UNHEALTHY:
+            self._routes_by_worker[worker.worker_id] = (
+                self._routes_by_worker.get(worker.worker_id, 0) + 1)
             return RouteResult(shard=shard, worker=worker)
         if not self.health_config.enable_failover:
             self._routing_errors += 1
@@ -182,6 +185,8 @@ class Router:
         self._failover_count += 1
         logger.warning("router: failover %s:%s key=%r shard %d→%d",
                        model, version, key, shard.shard_id, alt.shard_id)
+        self._routes_by_worker[alt.worker_id] = (
+            self._routes_by_worker.get(alt.worker_id, 0) + 1)
         return RouteResult(shard=alt, worker=self.workers[alt.worker_id],
                            failover=True)
 
@@ -285,6 +290,7 @@ class Router:
                     "address": w.address,
                     "health": w.health.value,
                     "consecutive_failures": w.consecutive_failures,
+                    "routes": self._routes_by_worker.get(w.worker_id, 0),
                 }
                 for w in self.workers.values()
             },

@@ -43,6 +43,8 @@ from ..utils.rpc import (
     RPCError,
     relay_stream,
 )
+from ..obs import collectors as obs_collectors
+from ..obs.registry import OPENMETRICS_CONTENT_TYPE, MetricsRegistry
 from ..utils.tracing import LatencyStats
 
 logger = logging.getLogger(__name__)
@@ -208,9 +210,17 @@ class WorkerServer(FramedServerMixin):
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
             "metrics": self._rpc_metrics,
+            "metrics_text": self._rpc_metrics_text,
             "profile": self._rpc_profile,
             "shutdown": self._rpc_shutdown,
         }
+        # unified telemetry: this worker's dict metrics (incl. every loaded
+        # engine's) mirrored into stable metric families at scrape time,
+        # exposed as OpenMetrics text via the metrics_text RPC verb and
+        # plain-HTTP GET /metrics on the same port (utils/rpc.py sniff)
+        self.obs_registry = MetricsRegistry()
+        obs_collectors.ensure_families(self.obs_registry)
+        self.obs_registry.add_collector(self._obs_collect)
         # streaming methods write chunk frames ahead of the final envelope
         self._stream_methods = {
             "generate_stream": self._rpc_generate_stream,
@@ -393,7 +403,27 @@ class WorkerServer(FramedServerMixin):
         return {"worker_id": self.worker_id, "time": time.time(),
                 "models": sorted(self.engines)}
 
+    def _attach_worker_trace(self, result: GenerationResult,
+                             t_recv: float) -> None:
+        """Worker-side phase marks, riding the result's metadata back to
+        the coordinator (cross-process tracing: ISSUE 4 leg 3). Offsets
+        are seconds RELATIVE TO THIS WORKER'S RECEIVE TIME — the two
+        processes share no clock, so the coordinator anchors them at its
+        own ``dispatched`` mark (``RequestTrace.add_offsets``).
+        ``first_token`` is the engine-measured TTFT (admission-relative,
+        ≈ receive-relative; exact for pumped continuous engines, which
+        stamp it from submit)."""
+        result.metadata.setdefault("worker_trace", {
+            "worker_id": self.worker_id,
+            "offsets": {
+                "received": 0.0,
+                "first_token": float(result.ttft_s),
+                "done": time.perf_counter() - t_recv,
+            },
+        })
+
     async def _rpc_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        t_recv = time.perf_counter()
         name, engine = self._engine_for(msg, "generate")
         reqs = [request_from_dict(d) for d in msg.get("requests", [])]
         if not reqs:
@@ -415,6 +445,8 @@ class WorkerServer(FramedServerMixin):
         # from real errors
         self._overloaded_count += sum(
             1 for r in results if r.finish_reason == "overloaded")
+        for r in results:
+            self._attach_worker_trace(r, t_recv)
         return {"model": name, "results": [result_to_dict(r) for r in results]}
 
     # -- streaming (token chunks ahead of the final result) -----------------
@@ -432,11 +464,13 @@ class WorkerServer(FramedServerMixin):
                 f"model {name!r} is not a continuous engine — streaming "
                 "needs metadata.continuous=1")
         req = request_from_dict(msg.get("request") or {})
+        t_recv = time.perf_counter()
         self._request_count += 1
         queue: asyncio.Queue = asyncio.Queue()
         fut = asyncio.ensure_future(
             pump.generate_streaming(req, queue.put_nowait))
         result = await relay_stream(fut, queue, send)
+        self._attach_worker_trace(result, t_recv)
         return {"model": name, "result": result_to_dict(result)}
 
     # -- profiling (SURVEY.md §5 tracing plan: XLA/TPU timeline capture) ----
@@ -456,13 +490,35 @@ class WorkerServer(FramedServerMixin):
             trace_dir = msg.get("trace_dir") or f"/tmp/{self.worker_id}-trace"
             jax.profiler.start_trace(trace_dir)
             self._profiling_dir = trace_dir
+            # bracket the engine step timelines to the same window: the
+            # jax trace shows the XLA/device side, the step timeline the
+            # engine's dispatch-level view of the SAME interval
+            for engine in self.engines.values():
+                tl = getattr(engine, "timeline", None)
+                if tl is not None:
+                    tl.start_capture()
             return {"profiling": True, "trace_dir": trace_dir}
         if action == "stop":
             if self._profiling_dir is None:
                 raise ValueError("profiling is not active")
             jax.profiler.stop_trace()
             out, self._profiling_dir = self._profiling_dir, None
-            return {"profiling": False, "trace_dir": out}
+            written: List[str] = []
+            for name, engine in self.engines.items():
+                tl = getattr(engine, "timeline", None)
+                if tl is None:
+                    continue
+                try:
+                    import os
+
+                    os.makedirs(out, exist_ok=True)
+                    path = os.path.join(out, f"step_timeline_{name}.json")
+                    written.append(tl.dump(path, tl.stop_capture()))
+                except Exception as e:  # timeline dump must not fail stop
+                    logger.warning("worker %s: step-timeline dump for %s "
+                                   "failed: %s", self.worker_id, name, e)
+            return {"profiling": False, "trace_dir": out,
+                    "step_timelines": written}
         raise ValueError(f"unknown profile action {action!r} "
                          "(use 'start' or 'stop')")
 
@@ -785,6 +841,24 @@ class WorkerServer(FramedServerMixin):
     async def _rpc_metrics(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return self.get_metrics()
 
+    def _obs_collect(self) -> None:
+        obs_collectors.clear_worker_labelled(self.obs_registry)
+        obs_collectors.apply_worker(self.obs_registry, self.get_metrics())
+
+    def metrics_text(self) -> str:
+        """This worker's metrics as OpenMetrics exposition text."""
+        return self.obs_registry.render()
+
+    async def _rpc_metrics_text(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return {"content_type": OPENMETRICS_CONTENT_TYPE,
+                "text": self.metrics_text()}
+
+    async def _http_get(self, path: str) -> Optional[Tuple[str, bytes]]:
+        if path == "/metrics":
+            return (OPENMETRICS_CONTENT_TYPE,
+                    self.metrics_text().encode("utf-8"))
+        return None
+
     async def _rpc_shutdown(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._shutdown_event.set()
         return {"shutting_down": True}
@@ -816,6 +890,11 @@ class WorkerServer(FramedServerMixin):
             "latency": self.latency.snapshot(),
             "models": {name: eng.get_metrics()
                        for name, eng in self.engines.items()},
+            # pump stats without the engine sub-dict ("models" above
+            # already carries every engine's metrics once)
+            "pumps": {name: {k: v for k, v in pump.get_stats().items()
+                             if k != "engine"}
+                      for name, pump in self._pumps.items()},
             "process": process,
         }
 
@@ -928,6 +1007,11 @@ class WorkerClient(FramedRPCClient):
 
     async def metrics(self) -> Dict[str, Any]:
         return await self.call("metrics")
+
+    async def metrics_text(self) -> str:
+        """The worker's OpenMetrics exposition text (``/metrics`` body)."""
+        result = await self.call("metrics_text")
+        return str(result["text"])
 
     async def shutdown(self) -> None:
         await self.call("shutdown")

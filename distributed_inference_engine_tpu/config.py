@@ -209,6 +209,11 @@ class EngineConfig:
                                        # removed the transient; the cap
                                        # remains for the sp path, which
                                        # keeps the two-program shape.
+    timeline_capacity: int = 4096      # step-timeline ring buffer (obs/
+                                       # timeline.py): per-dispatch records
+                                       # kept for the Perfetto export; the
+                                       # oldest fall off. 0 disables
+                                       # recording entirely.
 
 
 def validate_prefill_compose(prefill_chunk: int, sp: int = 1) -> None:

@@ -57,6 +57,7 @@ from ..ops.sampling import (
     sample_tokens,
     sample_tokens_with_logprobs,
 )
+from ..obs.timeline import StepTimeline
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache, page_chain_hashes
@@ -718,6 +719,13 @@ class ContinuousEngine:
         self._mixed_programs: set = set()
         self._occupancy_sum = 0     # Σ live slots per step (occupancy)
         self.ttft_stats = LatencyStats()   # per-request, from submit
+        # step timeline (obs/timeline.py): one record per device dispatch,
+        # exported as a Perfetto-loadable Chrome trace. Program-shape keys
+        # seen so far let records flag first-dispatch (compile) steps.
+        cap = int(getattr(config, "timeline_capacity", 4096) or 0)
+        self.timeline: Optional[StepTimeline] = (
+            StepTimeline(capacity=cap, name="continuous") if cap else None)
+        self._tl_programs: set = set()
 
     # ------------------------------------------------------------- submit
 
@@ -1003,6 +1011,8 @@ class ContinuousEngine:
         prefill-latency histogram; ``t_submit`` starts the request's
         TTFT clock (queue wait included)."""
         self.prefill_stats.add(time.perf_counter() - t_dispatch)
+        self._tl_record("prefill", t_dispatch, rows=1,
+                        prefill_tokens=prompt_len)
         if self._register_slot_host(req, slot, prompt_len, first,
                                     t_submit, on_tokens, first_lp=first_lp):
             self._install_device(
@@ -1202,6 +1212,9 @@ class ContinuousEngine:
                  and all(r.max_new_tokens > 1 for r, *_ in batch))
         if defer:
             self.prefill_stats.add(time.perf_counter() - t0)  # dispatch only
+            self._tl_record("prefill", t0, program=("prefill", bb, tb),
+                            rows=n, prefill_tokens=int(seq_lens.sum()),
+                            deferred=True)
             rows: List[Dict[str, Any]] = []
             cols: List[int] = []
             for i, (req, cb, slot, prompt, t_submit, full) in enumerate(batch):
@@ -1228,6 +1241,8 @@ class ContinuousEngine:
         firsts = fp[0]
         first_lps = fp[1].view(np.float32)
         self.prefill_stats.add(time.perf_counter() - t0)   # once per dispatch
+        self._tl_record("prefill", t0, program=("prefill", bb, tb),
+                        rows=n, prefill_tokens=int(seq_lens.sum()))
         rows = []
         for i, (req, cb, slot, prompt, t_submit, full) in enumerate(batch):
             if full is not None:
@@ -1371,6 +1386,8 @@ class ContinuousEngine:
             [prog.request for _, prog in items], k0)
         self._prefill_calls += 1
         self.prefill_stats.add(time.perf_counter() - t0)
+        self._tl_record("prefill_chunk", t0, rows=len(items),
+                        prefill_tokens=sum(len(s) for s in suffixes))
         fp = None                         # read back only if someone finished
         rows: List[Dict[str, Any]] = []
         for i, (slot, prog) in enumerate(items):
@@ -1533,6 +1550,8 @@ class ContinuousEngine:
                 rows.append(self._slot_row(prog.request, slot,
                                            len(prog.prompt), first))
         self._install_device(rows)
+        self._tl_record("mixed", t0, program=("mixed", rpb, qb),
+                        prefill_rows=len(sel), prefill_tokens=spent)
 
     # ---------------------------------------------------------- streaming
 
@@ -1719,6 +1738,39 @@ class ContinuousEngine:
 
     # --------------------------------------------------------------- step
 
+    def _tl_record(self, kind: str, t0: float, program: Any = None,
+                   **args: Any) -> None:
+        """Append one step-timeline record (no-op when disabled).
+
+        ``program`` is a hashable program-shape key; its first appearance
+        flags the record ``compile=True`` — on a real backend that step
+        paid an XLA compile (or compile-cache load). Occupancy args are
+        read from cheap host mirrors so the hot path stays unmetered
+        between scrapes."""
+        tl = self.timeline
+        if tl is None:
+            return
+        if program is not None and program not in self._tl_programs:
+            self._tl_programs.add(program)
+            args["compile"] = True
+        args["live_slots"] = len(self._slots)
+        args["waiting"] = len(self._waiting)
+        if self._prefilling:
+            args["prefilling"] = len(self._prefilling)
+        if self._swapped:
+            args["swapped"] = len(self._swapped)
+        try:
+            kv = self.kv
+            args["kv_pages_used"] = (kv.num_pages - len(kv._free)
+                                     - len(kv._reclaimable))
+            args["kv_pages_total"] = kv.num_pages
+            if kv.offload is not None:
+                args["host_pages"] = kv.offload.get_stats().get(
+                    "host_pages", 0)
+        except Exception:
+            pass
+        tl.record(kind, t0, time.perf_counter() - t0, **args)
+
     def step(self) -> int:
         """One engine iteration: admit, advance one prefill chunk, then one
         decode chunk. Returns live + mid-prefill slots after the
@@ -1837,6 +1889,8 @@ class ContinuousEngine:
                 self._process_packed(*prev)
         else:
             self._process_packed(packed, n_steps, snapshot, t0, cap_list)
+        self._tl_record("decode", t0, program=("decode", n_steps, mpb),
+                        rows=len(snapshot), n_steps=n_steps)
         return (len(self._slots) + len(self._prefilling)
                 + len(self._swapped))
 

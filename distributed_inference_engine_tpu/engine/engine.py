@@ -44,6 +44,7 @@ from ..ops.sampling import (
     sample_tokens,
     sample_tokens_with_logprobs,
 )
+from ..obs.timeline import StepTimeline
 from ..utils.tracing import LatencyStats
 from .types import (  # noqa: F401  (re-export)
     GenerationRequest,
@@ -217,6 +218,10 @@ class Engine:
         # ---- metrics
         self.prefill_stats = LatencyStats()
         self.decode_stats = LatencyStats()
+        cap = int(getattr(config, "timeline_capacity", 4096) or 0)
+        self.timeline: Optional[StepTimeline] = (
+            StepTimeline(capacity=cap, name="static") if cap else None)
+        self._tl_programs: set = set()
         self._total_requests = 0
         self._total_prompt_tokens = 0
         self._total_generated_tokens = 0
@@ -307,6 +312,13 @@ class Engine:
 
         ttft = time.perf_counter() - t0
         self.prefill_stats.add(ttft)
+        if self.timeline is not None:
+            prog = ("prefill", bb, tb)
+            first_seen = prog not in self._tl_programs
+            self._tl_programs.add(prog)
+            self.timeline.record("prefill", t0, ttft, rows=n,
+                                 prefill_tokens=int(seq_lens[:n].sum()),
+                                 **({"compile": True} if first_seen else {}))
 
         out_tokens: List[List[int]] = [[int(first_np[i])] for i in range(n)]
         out_lps: List[List[float]] = [[float(first_lp_np[i])]
@@ -362,6 +374,13 @@ class Engine:
                     jnp.asarray(stopped_rows, jnp.int32)].set(False)
         decode_t = time.perf_counter() - t1
         self.decode_stats.add(decode_t)
+        if self.timeline is not None:
+            prog = ("decode", bb, n_steps)
+            first_seen = prog not in self._tl_programs
+            self._tl_programs.add(prog)
+            self.timeline.record("decode", t1, decode_t, rows=n,
+                                 n_steps=n_steps,
+                                 **({"compile": True} if first_seen else {}))
 
         results = []
         for i, r in enumerate(requests):

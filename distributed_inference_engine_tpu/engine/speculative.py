@@ -60,6 +60,7 @@ from ..ops.sampling import (
     masked_sampling_probs,
     sample_tokens_with_logprobs,
 )
+from ..obs.timeline import StepTimeline
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .types import (
@@ -411,6 +412,10 @@ class SpeculativeEngine:
         # metrics
         self.prefill_stats = LatencyStats()
         self.round_stats = LatencyStats()
+        cap = int(getattr(config, "timeline_capacity", 4096) or 0)
+        self.timeline: Optional[StepTimeline] = (
+            StepTimeline(capacity=cap, name="speculative") if cap else None)
+        self._tl_programs: set = set()
         self._total_requests = 0
         self._total_prompt_tokens = 0
         self._total_generated = 0
@@ -503,6 +508,13 @@ class SpeculativeEngine:
         out_lps: List[List[float]] = [[float(first_lp[i])] for i in range(n)]
         ttft = time.perf_counter() - t0
         self.prefill_stats.add(ttft)
+        if self.timeline is not None:
+            prog = ("spec_prefill", bb, tb)
+            first_seen = prog not in self._tl_programs
+            self._tl_programs.add(prog)
+            self.timeline.record("spec_prefill", t0, ttft, rows=n,
+                                 prefill_tokens=int(sum(seq_lens[:n])),
+                                 **({"compile": True} if first_seen else {}))
 
         lengths = jnp.asarray(seq_lens)
         last = jnp.asarray(np.where(first >= 0, first, 0).astype(np.int32))
@@ -585,6 +597,13 @@ class SpeculativeEngine:
                     state[7])
         decode_t = time.perf_counter() - t1
         self.round_stats.add(decode_t)
+        if self.timeline is not None:
+            prog = ("spec_rounds", bb, R)
+            first_seen = prog not in self._tl_programs
+            self._tl_programs.add(prog)
+            self.timeline.record("spec_rounds", t1, decode_t, rows=n,
+                                 rounds_per_call=R, k=self.k,
+                                 **({"compile": True} if first_seen else {}))
 
         results = []
         for i, r in enumerate(requests):

@@ -1,0 +1,18 @@
+"""Unified telemetry (ISSUE 4): metrics registry + OpenMetrics exposition,
+engine step-timeline recording (Perfetto/Chrome trace export), and the
+collector mappings that translate every component's ad-hoc ``get_stats()``
+/ ``get_metrics()`` dict into stable metric families.
+
+Import discipline: nothing in this package imports jax (or anything that
+does) — the coordinator control plane and the docs/metric-name lint must
+be able to import it on a bare interpreter.
+"""
+
+from .registry import (  # noqa: F401
+    OPENMETRICS_CONTENT_TYPE,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .timeline import StepTimeline  # noqa: F401

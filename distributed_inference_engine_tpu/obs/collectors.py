@@ -1,0 +1,463 @@
+"""Collector mappings: each component's ``get_stats()``/``get_metrics()``
+dict → stable metric families in a ``MetricsRegistry``.
+
+The mapping TABLES below are the single source of truth for the metric
+catalog: ``CATALOG`` (name → kind, labels, help) is derived from them, the
+docs table in ``docs/observability.md`` is linted against it (both
+directions, ``scripts/lint_metrics.py``), and ``ensure_families()``
+registers every family so an exposition always carries the full catalog's
+``# TYPE``/``# HELP`` lines even for components that aren't live yet.
+
+Apply functions are pure dict→registry transformations (no component
+imports, no jax) so they are unit-testable on a bare interpreter and
+usable from bench scripts against saved stats dicts.
+
+Label conventions:
+- per-engine families (``engine_*``, ``kv_*``, ``offload_*``, ``pump_*``)
+  carry ``model`` and ``worker_id`` (empty ``worker_id`` for a local
+  engine outside any worker);
+- ``worker_*`` families carry ``worker_id``;
+- coordinator-side singletons (``coordinator_*``, ``batcher_*``,
+  ``cache_*``, ``router_*``, ``lb_*``, ``registry_*``) are unlabelled,
+  except the per-worker and per-health breakdowns noted in the tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from .registry import MetricsRegistry
+
+MODEL_LABELS = ("model", "worker_id")
+WORKER_LABELS = ("worker_id",)
+
+# -- mapping tables --------------------------------------------------------
+# (source_key, metric_name, kind, help); kind: c=counter g=gauge h=histogram
+
+ENGINE_TABLE = [
+    ("total_requests", "engine_requests", "c",
+     "Requests accepted by the engine"),
+    ("total_prompt_tokens", "engine_prompt_tokens", "c",
+     "Prompt tokens prefetched/prefilled"),
+    ("total_generated_tokens", "engine_generated_tokens", "c",
+     "Tokens generated (post stop-trim)"),
+    ("total_errors", "engine_errors", "c", "Engine-level request errors"),
+    ("admission_denied", "engine_admission_denied", "c",
+     "Admissions denied (no slot/pages at the time)"),
+    ("rejected_queue_full", "engine_rejected_queue_full", "c",
+     "Requests shed at submit: waiting queue full"),
+    ("shed_deadline", "engine_shed_deadline", "c",
+     "Requests shed after exceeding the queue deadline"),
+    ("capacity_finishes", "engine_capacity_finishes", "c",
+     "Sequences force-finished (reason=length) by KV-pool exhaustion"),
+    ("engine_steps", "engine_steps", "c",
+     "Engine iterations (decode or mixed dispatches)"),
+    ("prefill_calls", "engine_prefill_calls", "c",
+     "Prefill dispatches (whole-prompt or chunk)"),
+    ("mixed_steps", "engine_mixed_steps", "c",
+     "Ragged mixed-batch dispatches (decode + prefill chunks)"),
+    ("mixed_prefill_tokens", "engine_mixed_prefill_tokens", "c",
+     "Prefill tokens carried by mixed dispatches"),
+    ("prefix_hit_admissions", "engine_prefix_hit_admissions", "c",
+     "Admissions that reused cached prefix KV pages"),
+    ("chunked_admissions", "engine_chunked_admissions", "c",
+     "Admissions that prefill in chunks"),
+    ("deferred_admissions", "engine_deferred_admissions", "c",
+     "Admissions whose first-token read was deferred"),
+    ("rounds", "engine_spec_rounds", "c",
+     "Speculative target+draft verification rounds"),
+    ("waiting", "engine_waiting", "g", "Requests in the waiting queue"),
+    ("live_slots", "engine_live_slots", "g", "Decoding slots right now"),
+    ("prefilling_slots", "engine_prefilling_slots", "g",
+     "Slots mid chunked prefill"),
+    ("mixed_programs", "engine_mixed_programs", "g",
+     "Distinct compiled mixed-step programs"),
+    ("batch_occupancy", "engine_batch_occupancy", "g",
+     "Mean live slots / max_slots per engine step"),
+    ("speculate_k", "engine_spec_k", "g", "Draft tokens proposed per round"),
+    ("draft_acceptance_rate", "engine_spec_draft_acceptance_rate", "g",
+     "Accepted / proposed draft tokens"),
+    ("tokens_per_round", "engine_spec_tokens_per_round", "g",
+     "Mean tokens emitted per speculative round"),
+    ("ttft", "engine_ttft_seconds", "h",
+     "Time to first token (continuous: from submit, incl. queue wait)"),
+    ("prefill", "engine_prefill_seconds", "h", "Prefill dispatch wall time"),
+    ("decode_chunk", "engine_decode_chunk_seconds", "h",
+     "Decode-chunk wall time (defer_sync: residual blocking wait)"),
+    ("decode", "engine_decode_seconds", "h",
+     "Decode wall time per generate call (static/speculative engines)"),
+]
+
+ENGINE_OFFLOAD_TABLE = [          # engine.get_metrics()["kv_offload"]
+    ("swap_outs", "engine_swap_outs", "c",
+     "Decode victims swapped to the host tier under pool pressure"),
+    ("swap_resumes", "engine_swap_resumes", "c",
+     "Swapped sequences resumed with no re-prefill"),
+    ("swap_fallback_finishes", "engine_swap_fallback_finishes", "c",
+     "Swap attempts the host tier refused (finished reason=length)"),
+    ("swapped_parked", "engine_swapped_parked", "g",
+     "Sequences currently parked on the host tier"),
+    ("prefetch_hidden_latency_est_s",
+     "engine_prefetch_hidden_latency_est_seconds", "g",
+     "Estimated prefill seconds displaced by host-tier prefix hits"),
+]
+
+KV_TABLE = [                       # PagedKVCache.get_stats()
+    ("num_pages", "kv_pages", "g", "HBM page-pool size"),
+    ("page_size", "kv_page_size", "g", "Tokens per KV page"),
+    ("pages_used", "kv_pages_used", "g", "Pages allocated to live slots"),
+    ("pages_free", "kv_pages_free", "g", "Pages on the free list"),
+    ("pages_cached", "kv_pages_cached", "g",
+     "Reclaimable pages held by the prefix cache"),
+    ("peak_pages_used", "kv_peak_pages_used", "g",
+     "High-water pages_used since start"),
+    ("utilization", "kv_utilization", "g", "pages_used / num_pages"),
+    ("live_slots", "kv_live_slots", "g", "Slots with page tables"),
+    ("free_slots", "kv_free_slots", "g", "Unassigned slot ids"),
+    ("prefix_queries", "kv_prefix_queries", "c",
+     "Prefix-cache lookups at admission"),
+    ("prefix_hit_pages", "kv_prefix_hit_pages", "c",
+     "Pages served from the prefix cache"),
+    ("prefix_hit_tokens", "kv_prefix_hit_tokens", "c",
+     "Prompt tokens whose prefill was skipped via prefix hits"),
+    ("prefix_reclaimed", "kv_prefix_reclaimed", "c",
+     "Cached pages reclaimed for new allocations"),
+    ("prefix_indexed", "kv_prefix_indexed", "g",
+     "Page hashes currently in the prefix index"),
+    ("hbm_bytes", "kv_hbm_bytes", "g", "Device bytes held by the page pools"),
+]
+
+OFFLOAD_TABLE = [                  # kv get_stats()["host_tier"]
+    ("host_max_bytes", "offload_host_max_bytes", "g",
+     "Host-tier byte budget"),
+    ("host_lru_bytes", "offload_host_lru_bytes", "g",
+     "Host bytes held by the LRU store"),
+    ("host_swap_bytes", "offload_host_swap_bytes", "g",
+     "Host bytes reserved by swapped decode state"),
+    ("host_pages", "offload_host_pages", "g", "Pages resident on host"),
+    ("offloaded_pages", "offload_offloaded_pages", "c",
+     "Pages copied device to host on eviction"),
+    ("offloaded_bytes", "offload_offloaded_bytes", "c",
+     "Bytes copied device to host on eviction"),
+    ("host_hit_pages", "offload_hit_pages", "c",
+     "Host-tier pages matched by prefix probes"),
+    ("host_hit_bytes", "offload_hit_bytes", "c",
+     "Host-tier bytes matched by prefix probes"),
+    ("host_staged_pages", "offload_staged_pages", "c",
+     "Pages staged for host to device upload"),
+    ("host_evicted_pages", "offload_evicted_pages", "c",
+     "Host-tier pages evicted by the byte budget"),
+    ("host_rejected_pages", "offload_rejected_pages", "c",
+     "Offload attempts refused by the byte budget"),
+    ("host_hit_pages_admit", "offload_hit_pages_admit", "c",
+     "Host-tier pages actually restaged at admission"),
+    ("host_hit_tokens", "offload_hit_tokens", "c",
+     "Prompt tokens restaged from the host tier"),
+    ("uploaded_pages", "offload_uploaded_pages", "c",
+     "Pages uploaded host to device"),
+    ("uploaded_bytes", "offload_uploaded_bytes", "c",
+     "Bytes uploaded host to device"),
+    ("pending_offload", "offload_pending_offload", "g",
+     "Device to host copies queued for the next sync"),
+    ("pending_upload", "offload_pending_upload", "g",
+     "Host to device uploads in flight"),
+]
+
+PUMP_TABLE = [                     # EnginePump.get_stats() (sans "engine")
+    ("in_flight", "pump_in_flight", "g",
+     "Requests inside the pump (inbox + engine)"),
+    ("thread_alive", "pump_thread_alive", "g",
+     "1 while the engine thread is running"),
+    ("steps", "pump_steps", "c", "engine.step() calls by the pump thread"),
+    ("step_errors", "pump_step_errors", "c",
+     "Engine steps that raised (backed off and continued)"),
+    ("inbox_depth", "pump_inbox_depth", "g",
+     "Requests enqueued but not yet admitted"),
+]
+
+BATCHER_TABLE = [                  # Batcher.get_stats()
+    ("running", "batcher_running", "g", "1 while the batcher loop runs"),
+    ("total_requests", "batcher_requests", "c", "Requests enqueued"),
+    ("total_batches", "batcher_batches", "c", "Batches dispatched"),
+    ("total_batched_requests", "batcher_batched_requests", "c",
+     "Requests dispatched inside batches"),
+    ("total_errors", "batcher_errors", "c", "Batch dispatch errors"),
+    ("avg_batch_size", "batcher_avg_batch_size", "g",
+     "Mean requests per dispatched batch"),
+    ("pending_batches", "batcher_pending_batches", "g",
+     "Batches still collecting requests"),
+    ("pending_requests", "batcher_pending_requests", "g",
+     "Requests waiting in pending batches"),
+    ("inflight_batches", "batcher_inflight_batches", "g",
+     "Batches dispatched and awaiting results"),
+    ("queue_wait", "batcher_queue_wait_seconds", "h",
+     "Enqueue to batch-dispatch wait"),
+]
+
+CACHE_TABLE = [                    # ResponseCache.get_stats()
+    ("size", "cache_size", "g", "Entries in the response cache"),
+    ("max_size", "cache_max_size", "g", "Response-cache capacity"),
+    ("hits", "cache_hits", "c", "Response-cache hits"),
+    ("misses", "cache_misses", "c", "Response-cache misses"),
+    ("hit_rate", "cache_hit_rate", "g", "hits / (hits + misses)"),
+    ("evictions", "cache_evictions", "c", "Entries evicted by capacity"),
+    ("expirations", "cache_expirations", "c", "Entries expired by TTL"),
+]
+
+ROUTER_TABLE = [                   # ShardRouter.get_stats()
+    ("workers", "router_workers", "g", "Workers known to the router"),
+    ("route_count", "router_routes", "c", "Routing decisions"),
+    ("failover_count", "router_failovers", "c",
+     "Routes diverted off an unhealthy worker"),
+    ("routing_errors", "router_errors", "c", "Routing failures"),
+]
+
+LB_TABLE = [                       # LoadBalancer.get_all_stats()
+    ("pick_count", "lb_picks", "c", "Load-balancer worker picks"),
+    ("healthy_count", "lb_healthy_workers", "g", "Healthy workers"),
+]
+
+LB_WORKER_TABLE = [                # get_all_stats()["workers"][wid]
+    ("request_count", "lb_worker_requests", "c",
+     "Requests dispatched to this worker"),
+    ("error_count", "lb_worker_errors", "c", "Dispatch failures"),
+    ("active_connections", "lb_worker_active_connections", "g",
+     "In-flight dispatches held by the LB"),
+    ("avg_latency_s", "lb_worker_avg_latency_seconds", "g",
+     "Mean dispatch latency"),
+    ("healthy", "lb_worker_healthy", "g", "1 if the LB considers it healthy"),
+]
+
+REGISTRY_TABLE = [                 # ModelRegistry.get_stats()
+    ("models", "registry_models", "g", "Distinct models registered"),
+    ("versions", "registry_versions", "g", "Model versions registered"),
+    ("shards", "registry_shards", "g", "Shard placements registered"),
+    ("workers", "registry_workers", "g", "Workers serving any model"),
+]
+
+COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
+    ("submitted", "coordinator_submitted", "c",
+     "Requests submitted to the coordinator"),
+    ("cache_hits", "coordinator_cache_hits", "c",
+     "Submissions answered from the response cache"),
+    ("overload_rejections", "coordinator_overload_rejections", "c",
+     "Submissions shed by every tried replica"),
+]
+
+WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
+    ("uptime_s", "worker_uptime_seconds", "g", "Seconds since start"),
+    ("request_count", "worker_requests", "c",
+     "generate/generate_stream RPCs served"),
+    ("error_count", "worker_errors", "c", "RPC handler errors"),
+    ("overloaded_count", "worker_overloaded", "c",
+     "Requests shed by engine overload handling"),
+    ("handoff_bytes_shipped", "worker_handoff_bytes_shipped", "c",
+     "Disaggregated KV handoff bytes sent to decode peers"),
+    ("ping_count", "worker_pings", "c", "Health probes answered"),
+    ("active_connections", "worker_active_connections", "g",
+     "Open RPC connections"),
+    ("latency", "worker_request_seconds", "h",
+     "generate/generate_stream RPC wall time"),
+]
+
+# families whose label values are dynamic (declared here so the catalog
+# and ensure_families still cover them)
+EXTRA_FAMILIES = [
+    ("router_workers_by_health", "g", ("health",),
+     "Workers per router health state"),
+    ("router_worker_routes", "c", ("worker_id",),
+     "Routing decisions landing on this worker"),
+    ("worker_rss_bytes", "g", WORKER_LABELS,
+     "Worker process resident set size (psutil, 0 if unavailable)"),
+]
+
+_GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
+    (ENGINE_TABLE, MODEL_LABELS),
+    (ENGINE_OFFLOAD_TABLE, MODEL_LABELS),
+    (KV_TABLE, MODEL_LABELS),
+    (OFFLOAD_TABLE, MODEL_LABELS),
+    (PUMP_TABLE, MODEL_LABELS),
+    (BATCHER_TABLE, ()),
+    (CACHE_TABLE, ()),
+    (ROUTER_TABLE, ()),
+    (LB_TABLE, ()),
+    (LB_WORKER_TABLE, WORKER_LABELS),
+    (REGISTRY_TABLE, ()),
+    (COORDINATOR_TABLE, ()),
+    (WORKER_TABLE, WORKER_LABELS),
+]
+
+_KINDS = {"c": "counter", "g": "gauge", "h": "histogram"}
+
+
+def _build_catalog() -> Dict[str, Tuple[str, Tuple[str, ...], str]]:
+    cat: Dict[str, Tuple[str, Tuple[str, ...], str]] = {}
+    for table, labels in _GROUPS:
+        for _src, name, kind, help in table:
+            prev = cat.get(name)
+            entry = (_KINDS[kind], labels, help)
+            if prev is not None and prev[:2] != entry[:2]:
+                raise AssertionError(f"catalog conflict for {name}")
+            cat[name] = entry
+    for name, kind, labels, help in EXTRA_FAMILIES:
+        cat[name] = (_KINDS[kind], tuple(labels), help)
+    return cat
+
+
+#: metric family name -> (kind, labelnames, help). The docs catalog table
+#: is linted against exactly this mapping (scripts/lint_metrics.py).
+CATALOG: Dict[str, Tuple[str, Tuple[str, ...], str]] = _build_catalog()
+
+
+def ensure_families(reg: MetricsRegistry) -> None:
+    """Register every catalog family (idempotent) so the exposition always
+    carries the full set of TYPE/HELP lines."""
+    for name, (kind, labels, help) in CATALOG.items():
+        getattr(reg, kind)(name, help, labels)
+
+
+def clear_worker_labelled(reg: MetricsRegistry) -> None:
+    """Drop children of every family labelled by worker_id so a rebuild
+    collector doesn't leave series for departed workers behind."""
+    for name in reg.names:
+        fam = reg.get(name)
+        if fam is not None and "worker_id" in fam.labelnames:
+            fam.clear()
+
+
+# -- apply functions -------------------------------------------------------
+
+def _apply_table(reg: MetricsRegistry, table, src: Mapping[str, Any],
+                 labelnames: Tuple[str, ...],
+                 labels: Dict[str, str]) -> None:
+    for src_key, name, kind, help in table:
+        if src_key not in src:
+            continue                       # subset-tolerant: engines differ
+        v = src[src_key]
+        if kind == "c":
+            reg.counter(name, help, labelnames).labels(**labels).set(
+                float(v))
+        elif kind == "g":
+            reg.gauge(name, help, labelnames).labels(**labels).set(float(v))
+        elif kind == "h" and isinstance(v, Mapping):
+            buckets = v.get("buckets")
+            if buckets:
+                reg.histogram(name, help, labelnames).labels(
+                    **labels).set_snapshot(
+                        buckets, v.get("sum_s", 0.0), v.get("count", 0))
+
+
+def apply_engine(reg: MetricsRegistry, m: Optional[Mapping[str, Any]],
+                 model: str = "", worker_id: str = "") -> None:
+    """One engine's ``get_metrics()`` dict (continuous / static / fake /
+    speculative — subset-tolerant), including its kv / host-tier /
+    offload sub-dicts."""
+    if not m:
+        return
+    labels = {"model": model, "worker_id": worker_id}
+    _apply_table(reg, ENGINE_TABLE, m, MODEL_LABELS, labels)
+    off = m.get("kv_offload")
+    if isinstance(off, Mapping):
+        _apply_table(reg, ENGINE_OFFLOAD_TABLE, off, MODEL_LABELS, labels)
+    kv = m.get("kv")
+    if isinstance(kv, Mapping):
+        _apply_table(reg, KV_TABLE, kv, MODEL_LABELS, labels)
+        host = kv.get("host_tier")
+        if isinstance(host, Mapping):
+            _apply_table(reg, OFFLOAD_TABLE, host, MODEL_LABELS, labels)
+
+
+def apply_pump(reg: MetricsRegistry, ps: Optional[Mapping[str, Any]],
+               model: str = "", worker_id: str = "") -> None:
+    if not ps:
+        return
+    _apply_table(reg, PUMP_TABLE, ps, MODEL_LABELS,
+                 {"model": model, "worker_id": worker_id})
+
+
+def apply_batcher(reg: MetricsRegistry,
+                  bs: Optional[Mapping[str, Any]]) -> None:
+    if bs:
+        _apply_table(reg, BATCHER_TABLE, bs, (), {})
+
+
+def apply_cache(reg: MetricsRegistry,
+                cs: Optional[Mapping[str, Any]]) -> None:
+    if cs:
+        _apply_table(reg, CACHE_TABLE, cs, (), {})
+
+
+def apply_router(reg: MetricsRegistry,
+                 rs: Optional[Mapping[str, Any]]) -> None:
+    if not rs:
+        return
+    _apply_table(reg, ROUTER_TABLE, rs, (), {})
+    by_health = rs.get("workers_by_health")
+    if isinstance(by_health, Mapping):
+        fam = reg.gauge("router_workers_by_health",
+                        CATALOG["router_workers_by_health"][2], ("health",))
+        for health, n in by_health.items():
+            fam.labels(health=str(health)).set(float(n))
+    detail = rs.get("worker_detail")
+    if isinstance(detail, Mapping):
+        fam = reg.counter("router_worker_routes",
+                          CATALOG["router_worker_routes"][2], ("worker_id",))
+        for wid, d in detail.items():
+            if isinstance(d, Mapping) and "routes" in d:
+                fam.labels(worker_id=str(wid)).set(float(d["routes"]))
+
+
+def apply_lb(reg: MetricsRegistry, ls: Optional[Mapping[str, Any]]) -> None:
+    if not ls:
+        return
+    _apply_table(reg, LB_TABLE, ls, (), {})
+    workers = ls.get("workers")
+    if isinstance(workers, Mapping):
+        for wid, ws in workers.items():
+            if isinstance(ws, Mapping):
+                _apply_table(reg, LB_WORKER_TABLE, ws, WORKER_LABELS,
+                             {"worker_id": str(wid)})
+
+
+def apply_registry_stats(reg: MetricsRegistry,
+                         gs: Optional[Mapping[str, Any]]) -> None:
+    if gs:
+        _apply_table(reg, REGISTRY_TABLE, gs, (), {})
+
+
+def apply_coordinator(reg: MetricsRegistry,
+                      cs: Optional[Mapping[str, Any]]) -> None:
+    """A ``Coordinator.get_stats()`` dict: top-level counters plus the
+    cache / batcher / router / lb / registry sub-dicts."""
+    if not cs:
+        return
+    _apply_table(reg, COORDINATOR_TABLE, cs, (), {})
+    apply_cache(reg, cs.get("cache"))
+    apply_batcher(reg, cs.get("batcher"))
+    apply_router(reg, cs.get("router"))
+    apply_lb(reg, cs.get("load_balancer"))
+    apply_registry_stats(reg, cs.get("registry"))
+
+
+def apply_worker(reg: MetricsRegistry, wm: Optional[Mapping[str, Any]],
+                 worker_id: Optional[str] = None) -> None:
+    """A ``WorkerServer.get_metrics()`` dict: worker families plus every
+    loaded model's engine metrics and pump stats."""
+    if not wm:
+        return
+    wid = str(worker_id if worker_id is not None
+              else wm.get("worker_id", ""))
+    _apply_table(reg, WORKER_TABLE, wm, WORKER_LABELS, {"worker_id": wid})
+    proc = wm.get("process")
+    if isinstance(proc, Mapping) and "rss_bytes" in proc:
+        reg.gauge("worker_rss_bytes", CATALOG["worker_rss_bytes"][2],
+                  WORKER_LABELS).labels(worker_id=wid).set(
+                      float(proc["rss_bytes"]))
+    models = wm.get("models")
+    if isinstance(models, Mapping):
+        for model, em in models.items():
+            apply_engine(reg, em, model=str(model), worker_id=wid)
+    pumps = wm.get("pumps")
+    if isinstance(pumps, Mapping):
+        for model, ps in pumps.items():
+            apply_pump(reg, ps, model=str(model), worker_id=wid)

@@ -1,0 +1,110 @@
+"""StepTimeline: a ring-buffer recorder for engine dispatches, exported as
+Chrome trace-event JSON (loadable in Perfetto / ``chrome://tracing``).
+
+``jax.profiler`` captures the XLA/TPU device timeline; what it cannot show
+is the ENGINE's view — which step was a mixed ragged dispatch vs a pure
+decode chunk, how many prefill tokens rode along, what the KV pool and
+host tier looked like at that moment, and which dispatches paid a first
+-execution (compile) cost. This recorder captures exactly that, cheaply
+(one small dict appended to a bounded deque per dispatch — against step
+times in the tens of milliseconds), and brackets cleanly around the
+worker's ``jax.profiler`` start/stop hooks so the two timelines cover the
+same window.
+
+Trace-event mapping: each step is a complete event (``"ph": "X"``) with
+microsecond ``ts``/``dur`` relative to the timeline's epoch; markers are
+instant events (``"ph": "i"``). Event ``args`` carry the per-step payload
+(rows, prefill tokens, pool occupancy, ``compile``) and show up in the
+Perfetto slice-details pane.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class StepTimeline:
+    """Bounded per-engine step recorder with Chrome trace export."""
+
+    def __init__(self, capacity: int = 4096, name: str = "engine") -> None:
+        self.name = name
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=max(1, self.capacity))
+        self._epoch = time.perf_counter()
+        self._capture_from: Optional[float] = None
+        self._dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, kind: str, t_start: float, dur_s: float,
+               **args: Any) -> None:
+        """One complete dispatch: ``t_start`` is a ``time.perf_counter()``
+        stamp, ``dur_s`` its wall duration."""
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
+        self._events.append({"name": kind, "t": float(t_start),
+                             "dur": float(dur_s), "args": args})
+
+    def instant(self, kind: str, **args: Any) -> None:
+        if len(self._events) == self._events.maxlen:
+            self._dropped += 1
+        self._events.append({"name": kind, "t": time.perf_counter(),
+                             "dur": None, "args": args})
+
+    # -- capture window (brackets jax.profiler start/stop) -----------------
+
+    def start_capture(self) -> None:
+        self._capture_from = time.perf_counter()
+
+    def stop_capture(self) -> List[Dict[str, Any]]:
+        """Events recorded since ``start_capture()`` (all events if the
+        window was never opened). Leaves the ring intact."""
+        since, self._capture_from = self._capture_from, None
+        return self.events(since=since)
+
+    def events(self, since: Optional[float] = None) -> List[Dict[str, Any]]:
+        evs = list(self._events)
+        if since is not None:
+            evs = [e for e in evs if e["t"] >= since]
+        return evs
+
+    # -- export ------------------------------------------------------------
+
+    def to_chrome_trace(self, events: Optional[List[Dict[str, Any]]] = None,
+                        pid: int = 0, tid: int = 0) -> Dict[str, Any]:
+        """Chrome trace-event JSON object (the ``traceEvents`` container
+        format Perfetto ingests directly)."""
+        if events is None:
+            events = self.events()
+        out: List[Dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": self.name},
+        }]
+        for e in events:
+            ts = (e["t"] - self._epoch) * 1e6
+            if e["dur"] is None:
+                out.append({"name": e["name"], "ph": "i", "s": "t",
+                            "ts": ts, "pid": pid, "tid": tid,
+                            "args": dict(e["args"])})
+            else:
+                out.append({"name": e["name"], "ph": "X", "ts": ts,
+                            "dur": e["dur"] * 1e6, "pid": pid, "tid": tid,
+                            "args": dict(e["args"])})
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "metadata": {"timeline": self.name,
+                         "dropped_events": self._dropped},
+        }
+
+    def dump(self, path: str,
+             events: Optional[List[Dict[str, Any]]] = None) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(events), f)
+        return path

@@ -36,7 +36,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..utils.tracing import RequestTrace
+from ..utils.tracing import LatencyStats, RequestTrace
 
 logger = logging.getLogger(__name__)
 
@@ -104,6 +104,7 @@ class Batcher:
         self._total_batched_requests = 0
         self._total_errors = 0
         self._batch_size_sum = 0
+        self._queue_wait = LatencyStats()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -227,7 +228,9 @@ class Batcher:
         n_real = len(inputs)
         n_padded = self._padded_size(n_real)
         inputs = inputs + [PAD_INPUT] * (n_padded - n_real)
+        t_dispatch = time.monotonic()
         for r in reqs:
+            self._queue_wait.add(t_dispatch - r.enqueued_at)
             if r.trace is not None:
                 r.trace.mark("batched")
         try:
@@ -272,4 +275,5 @@ class Batcher:
             "inflight_batches": len(self._inflight),
             "max_batch_size": self.max_batch_size,
             "max_latency_ms": self.max_latency_ms,
+            "queue_wait": self._queue_wait.snapshot(),
         }

@@ -64,6 +64,7 @@ class EnginePump:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._step_errors = 0
+        self._steps = 0
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ asyncio
@@ -163,6 +164,7 @@ class EnginePump:
             live = 0
             try:
                 if admitted or self.engine.n_live or self.engine.n_waiting:
+                    self._steps += 1
                     live = self.engine.step()
                     for res in self.engine.drain_finished():
                         self._resolve(res)
@@ -250,9 +252,13 @@ class EnginePump:
     # ------------------------------------------------------------- stats
 
     def get_stats(self) -> Dict[str, Any]:
+        with self._inbox_lock:
+            inbox_depth = len(self._inbox)
         return {
             "in_flight": len(self._futures),
             "thread_alive": bool(self._thread and self._thread.is_alive()),
+            "steps": self._steps,
             "step_errors": self._step_errors,
+            "inbox_depth": inbox_depth,
             "engine": self.engine.get_metrics(),
         }

@@ -107,6 +107,23 @@ async def read_frame(
     return await asyncio.wait_for(_read(), timeout=timeout)
 
 
+async def read_frame_after_header(
+    reader: asyncio.StreamReader,
+    header: bytes,
+    max_frame: int = DEFAULT_MAX_FRAME,
+) -> Any:
+    """Finish reading a frame whose ``HEADER_SIZE`` bytes were already
+    consumed (the server's first-read protocol sniff — utils/rpc.py peeks
+    at a connection's first bytes to tell framed RPC from plain HTTP)."""
+    magic, codec, _flags, length = HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameError(f"bad magic 0x{magic:04x}")
+    if length > max_frame:
+        raise FrameError(f"frame of {length} bytes exceeds max {max_frame}")
+    payload = await reader.readexactly(length)
+    return _decode_payload(codec, payload)
+
+
 async def write_frame(
     writer: asyncio.StreamWriter, obj: Any, codec: int = CODEC_MSGPACK
 ) -> None:

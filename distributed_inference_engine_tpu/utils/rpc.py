@@ -14,9 +14,21 @@ import logging
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
-from .framing import FrameError, read_frame, write_frame
+from .framing import (
+    HEADER_SIZE,
+    FrameError,
+    read_frame,
+    read_frame_after_header,
+    write_frame,
+)
 
 logger = logging.getLogger(__name__)
+
+# a framed message starts with magic 0xD17E — never printable ASCII — so a
+# connection whose first four bytes spell an HTTP verb is unambiguously a
+# plain HTTP client (curl/Prometheus hitting GET /metrics on the RPC port)
+_HTTP_VERB_PREFIXES = (b"GET ", b"HEAD", b"POST", b"PUT ", b"DELE",
+                       b"OPTI", b"PATC")
 
 
 class RPCError(RuntimeError):
@@ -285,11 +297,26 @@ class FramedServerMixin:
     ) -> None:
         self._conn_writers.add(writer)
         try:
+            first = True
             while True:
                 try:
-                    msg = await read_frame(
-                        reader, max_frame=self.max_frame_bytes, timeout=None
-                    )
+                    if first:
+                        # sniff the connection's first bytes: an HTTP verb
+                        # means a plain-HTTP scraper (GET /metrics) — hand
+                        # the connection to the HTTP hook; anything else
+                        # must be a frame header (magic-validated below)
+                        first = False
+                        head = await reader.readexactly(HEADER_SIZE)
+                        if head[:4] in _HTTP_VERB_PREFIXES:
+                            await self._serve_http(head, reader, writer)
+                            break
+                        msg = await read_frame_after_header(
+                            reader, head, max_frame=self.max_frame_bytes)
+                    else:
+                        msg = await read_frame(
+                            reader, max_frame=self.max_frame_bytes,
+                            timeout=None,
+                        )
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break  # client closed
                 except FrameError as e:
@@ -397,6 +424,56 @@ class FramedServerMixin:
         self._after_dispatch(method, req_id, time.perf_counter() - t0,
                              response)
         return response
+
+    # -- plain-HTTP side door (GET /metrics on the RPC port) ---------------
+
+    async def _serve_http(self, head: bytes, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """Answer ONE plain-HTTP request on the framed port, then let the
+        caller close the connection. Only GET/HEAD reach ``_http_get``;
+        everything else (and unknown paths) gets a 404. Deliberately
+        minimal — this exists so ``curl``/Prometheus can scrape
+        ``/metrics`` without speaking the frame protocol, not to be a web
+        server."""
+        try:
+            raw = head
+            if b"\r\n\r\n" not in raw:
+                raw += await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=5.0)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError, ConnectionResetError):
+            return
+        parts = raw.split(b"\r\n", 1)[0].decode("latin-1").split()
+        method = parts[0].upper() if parts else ""
+        path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+        status, ctype, body = "404 Not Found", "text/plain; charset=utf-8", \
+            b"not found\n"
+        if method in ("GET", "HEAD"):
+            try:
+                got = await self._http_get(path)
+            except Exception as e:
+                logger.warning("%s: HTTP %s %s failed: %s",
+                               type(self).__name__, method, path, e)
+                got = None
+                status, body = ("500 Internal Server Error",
+                                f"{e}\n".encode("utf-8", "replace"))
+            if got is not None:
+                ctype, body = got[0], got[1]
+                status = "200 OK"
+        payload = b"" if method == "HEAD" else body
+        try:
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n".encode("latin-1") + payload)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+
+    async def _http_get(self, path: str) -> Optional[Tuple[str, bytes]]:
+        """Override hook: return ``(content_type, body)`` or None for 404."""
+        return None
 
     async def _run_handler(self, method: str, handler, msg) -> Any:
         return await handler(msg)

@@ -12,15 +12,36 @@ BASELINE.json metrics.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
 
 
 def new_request_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# fixed histogram bucket bounds (seconds) shared with the metrics registry
+# (obs/registry.py imports these as its default): LatencyStats snapshots
+# carry cumulative counts over EXACTLY these bounds, so they export as
+# OpenMetrics histograms without translation
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def format_bucket_bound(bound: float) -> str:
+    """Canonical ``le`` label for a bucket bound (shortest float form)."""
+    f = float(bound)
+    if f == float("inf"):
+        return "+Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
 
 
 @dataclass
@@ -57,6 +78,25 @@ class RequestTrace:
     def total(self) -> Optional[float]:
         return self.span("received", "responded")
 
+    def add_offsets(self, prefix: str, offsets: Dict[str, float],
+                    anchor: Optional[float] = None) -> None:
+        """Merge REMOTE phase marks recorded as offsets on another clock.
+
+        A worker cannot share this trace's ``time.monotonic`` epoch, so it
+        reports phases as offsets from its own receive time; anchoring
+        them at this trace's ``dispatched`` mark (network transit folds
+        into the remote ``received``≈0 offset) lands them on the local
+        timeline. ``mark()``'s first-wins semantics are preserved via
+        ``setdefault``. ``anchor`` is an absolute local monotonic stamp;
+        defaults to the ``dispatched`` (else ``received``) mark."""
+        if anchor is None:
+            anchor = self.marks.get("dispatched",
+                                    self.marks.get("received", 0.0))
+        for phase, off in offsets.items():
+            if isinstance(off, (int, float)):
+                self.marks.setdefault(f"{prefix}{phase}",
+                                      anchor + float(off))
+
     def to_dict(self) -> Dict[str, float]:
         base = self.marks.get("received", 0.0)
         d = {k: v - base for k, v in self.marks.items()}
@@ -78,18 +118,27 @@ def trace_span(trace: Optional[RequestTrace], start: str, end: str) -> Iterator[
 class LatencyStats:
     """Streaming latency accumulator with percentile snapshots.
 
-    Keeps a bounded reservoir so long-running workers don't grow unboundedly.
+    Keeps a bounded reservoir so long-running workers don't grow
+    unboundedly. Fixed-bucket counts (over ``LATENCY_BUCKETS``) accumulate
+    over EVERY observation — unlike the percentiles, they never decimate —
+    so ``snapshot()`` exports as a proper OpenMetrics histogram
+    (cumulative buckets + sum + count).
     """
 
-    def __init__(self, reservoir: int = 4096) -> None:
+    def __init__(self, reservoir: int = 4096,
+                 buckets: tuple = LATENCY_BUCKETS) -> None:
         self._samples: list[float] = []
         self._reservoir = reservoir
+        self._buckets = tuple(sorted(float(b) for b in buckets))
+        self._bucket_counts = [0] * (len(self._buckets) + 1)  # +Inf tail
         self.count = 0
         self.total = 0.0
 
     def add(self, latency_s: float) -> None:
         self.count += 1
         self.total += latency_s
+        self._bucket_counts[
+            bisect.bisect_left(self._buckets, latency_s)] += 1
         if len(self._samples) < self._reservoir:
             self._samples.append(latency_s)
         else:
@@ -107,11 +156,23 @@ class LatencyStats:
         idx = min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))
         return s[idx]
 
-    def snapshot(self) -> Dict[str, float]:
+    def bucket_counts(self) -> Dict[str, int]:
+        """CUMULATIVE counts keyed by their ``le`` label (+Inf last)."""
+        out: Dict[str, int] = {}
+        cum = 0
+        for bound, n in zip(self._buckets, self._bucket_counts):
+            cum += n
+            out[format_bucket_bound(bound)] = cum
+        out["+Inf"] = self.count
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
         return {
             "count": self.count,
             "mean_s": self.mean,
             "p50_s": self.percentile(50),
             "p95_s": self.percentile(95),
             "p99_s": self.percentile(99),
+            "sum_s": self.total,
+            "buckets": self.bucket_counts(),
         }

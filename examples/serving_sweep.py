@@ -76,7 +76,7 @@ from distributed_inference_engine_tpu.engine.types import (  # noqa: E402
 from distributed_inference_engine_tpu.serving.pump import EnginePump  # noqa: E402
 
 
-async def run_rate(pump, spec, rate, n_requests, seed):
+async def run_rate(pump, spec, rate, n_requests, seed, trace_sink=None):
     engine = pump.engine
     ttfts, itls = [], []
     rejected = [0]
@@ -96,6 +96,10 @@ async def run_rate(pump, spec, rate, n_requests, seed):
         except EngineOverloadedError:
             rejected[0] += 1
             return 0
+        if trace_sink is not None:
+            row = bench._result_row(res)
+            row["rate"] = rate
+            trace_sink.append(row)
         ttfts.append(res.ttft_s)
         prev = None
         for t, k in marks:
@@ -170,11 +174,13 @@ def main():
     bench.prime_pump(pump, spec, bench.BATCH)
     trials = max(1, int(os.environ.get("SWEEP_TRIALS", "3")))
     rows = []
+    trace_sink: list = []
     for i, rate in enumerate(rates):
         trial_rows = []
         for t in range(trials):
             r = asyncio.run(run_rate(pump, spec, rate, n_requests,
-                                     100 + trials * i + t))
+                                     100 + trials * i + t,
+                                     trace_sink=trace_sink))
             trial_rows.append(r)
             log(f"  rate {rate:g} trial {t + 1}/{trials}: "
                 f"{r['goodput_toks']} tok/s")
@@ -189,6 +195,9 @@ def main():
         rows.append(row)
         print(json.dumps(row), flush=True)
     asyncio.run(pump.stop())
+    # registry snapshot + per-request traces + step timeline next to the
+    # sweep output (BENCH_OBS_DIR, default bench_obs; "0" disables)
+    bench.dump_obs(engine, trace_sink, "sweep", pump=pump)
 
     log("\n| offered req/s | goodput tok/s (median) | band | served | "
         "rejected | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 | occupancy |")

@@ -21,7 +21,7 @@ if python -c "import pyflakes" 2>/dev/null; then
 else
     echo "== compileall (pyflakes not installed) =="
     python -m compileall -q distributed_inference_engine_tpu tests \
-        bench.py examples || rc=1
+        bench.py examples scripts || rc=1
 fi
 
 if [ "$rc" -ne 0 ]; then
@@ -32,6 +32,28 @@ fi
 if [ "${SKIP_TESTS:-0}" = "1" ]; then
     echo "check.sh: static checks OK (tests skipped)"
     exit 0
+fi
+
+# --- stage 2a: metric-name lint ---------------------------------------
+# docs/observability.md catalog table <-> obs/collectors.CATALOG, both
+# directions (bare interpreter, no jax) — drift fails in milliseconds.
+echo "== metric-name lint (scripts/lint_metrics.py) =="
+python scripts/lint_metrics.py || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: metric-name lint FAILED" >&2
+    exit "$rc"
+fi
+
+# --- stage 2b: fast observability leg ---------------------------------
+# registry/exposition/timeline/trace tests (-m obs) run standalone next:
+# a telemetry regression fails here in seconds.
+echo "== observability (-m 'obs and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'obs and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: observability leg FAILED" >&2
+    exit "$rc"
 fi
 
 # --- stage 2: fast kernel-parity leg ----------------------------------

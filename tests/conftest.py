@@ -50,6 +50,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "kernels: Pallas kernel parity tests (fast standalone "
         "leg: pytest -m 'kernels and not slow')")
+    config.addinivalue_line(
+        "markers", "obs: observability tests (metrics registry, step "
+        "timeline, trace propagation; fast leg: pytest -m 'obs and not "
+        "slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):
