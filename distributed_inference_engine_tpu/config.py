@@ -103,6 +103,17 @@ class EngineConfig:
     # one dispatch when prefill_chunk > 0; pure-decode chunks fall back
     # to the flash-decode kernel); append "_interpret" to any for CPU
     # interpret mode
+    decode_fused: bool = False         # decode megastep (ISSUE 5): fold
+                                       # RMSNorm into the QKV / gate-up
+                                       # matmul prologue and the residual
+                                       # add into the attn-out / down-proj
+                                       # epilogue (ops/fused_decode.py) on
+                                       # PLAIN bf16/f32 weights — bit-
+                                       # identical tokens, fewer HBM
+                                       # round-trips of the [B, D]
+                                       # activation stream. Quantized
+                                       # layers keep their Mosaic kernels
+                                       # (dequant already fused there).
     decode_mode: str = "window"        # continuous engine: "window" freezes
                                        # the page pools per chunk, gathers
                                        # the live prefix ONCE into a dense

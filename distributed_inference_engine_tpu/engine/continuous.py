@@ -71,6 +71,10 @@ from .types import (
 
 logger = logging.getLogger(__name__)
 
+# device-side stop-id capacity per slot (ISSUE 5b): requests with more
+# single-token stop ids than this keep the extras on the host scan path
+_DEVICE_STOP_K = 8
+
 
 class _Slot:
     """Host-side bookkeeping for one live sequence."""
@@ -281,7 +285,27 @@ class ContinuousEngine:
         # the device sat idle). Deferral engages only under decode
         # pressure — see _admit_batch.
         self._firsts_dev = jnp.zeros((2, n), jnp.int32)
+        # host cache of the firsts buffer (ISSUE 5 satellite): retire-path
+        # rescues (_finish/_try_swap_out) used to pay one [2]-element
+        # device round trip PER SLOT; the packed chunk output already
+        # carries the whole buffer, so sync chunk processing caches it
+        # here and a retire wave reads it for free. None = stale (an
+        # install rewrote columns); _firsts_snapshot then refetches the
+        # WHOLE buffer once, not per slot.
+        self._firsts_host: Optional[np.ndarray] = None
         self._defer_admit = bool(getattr(cfg, "defer_admission", True))
+        # device-side stop ids (ISSUE 5b): the first _DEVICE_STOP_K
+        # single-token stops per slot ride a [n, K] matrix so the decode
+        # loop retires a stopped slot IN-CHUNK instead of generating (and
+        # paying bandwidth for) up to n_steps-1 dead tokens until the
+        # host scan catches up. Host find_stop_cut stays the source of
+        # truth: overflow ids and multi-token stop_sequences still retire
+        # there, and _finish's trim_at_stops names the reason either way.
+        self._stops_dev = jnp.full((n, _DEVICE_STOP_K), -1, jnp.int32)
+        # live slots whose row holds real ids: when empty (the common
+        # case) dispatches select the stop-free program variant, so
+        # engines that never see stop_ids never pay the extra compile
+        self._stop_slots: set = set()
         # host mirror of per-slot lengths: the capacity loop consults it
         # every step, and a device readback costs a full round trip
         # (~100 ms on tunnelled/remote devices). Updated on admission and
@@ -400,8 +424,15 @@ class ContinuousEngine:
             decode_impl = self.attn_impl
         self._mixed = (self.attn_impl.startswith("pallas-ragged")
                        and self._chunk > 0)
-        fwd = partial(forward_decode_paged, attn_impl=decode_impl)
-        fwd_window = partial(forward_decode_window, attn_impl=decode_impl)
+        # decode megastep (ISSUE 5a): fold RMSNorm into the QKV / gate-up
+        # matmul and the residual add into the out/down projection for
+        # plain-weight layers (ops/fused_decode.py — bit-identical by
+        # construction; quantized layers keep their Mosaic kernels)
+        decode_fused = bool(getattr(cfg, "decode_fused", False))
+        fwd = partial(forward_decode_paged, attn_impl=decode_impl,
+                      fused=decode_fused)
+        fwd_window = partial(forward_decode_window, attn_impl=decode_impl,
+                             fused=decode_fused)
         # Windowed chunks freeze the page pools for the duration of a decode
         # chunk — the per-step page scatter they replace held decode at ~28%
         # of the dense engine's throughput at 8B bs64. Small-KV models
@@ -428,12 +459,14 @@ class ContinuousEngine:
         use_dense_ctx = use_window and not self.attn_impl.startswith("pallas")
         self._use_dense_ctx = use_dense_ctx
 
-        @partial(jax.jit, static_argnames=("n_steps", "n_ctx_pages"),
+        @partial(jax.jit,
+                 static_argnames=("n_steps", "n_ctx_pages", "use_stops"),
                  donate_argnums=(1, 2, 3, 4, 5, 6))
         def _decode_chunk(
             params, kp, vp, lengths, last_tokens, active, produced,
-            page_table, cap, max_new, sampling, eos_ids, firsts, key,
-            n_steps: int, n_ctx_pages: int = 0,
+            page_table, cap, max_new, sampling, eos_ids, stop_mat, firsts,
+            key, n_steps: int, n_ctx_pages: int = 0,
+            use_stops: bool = False,
         ):
             start_lengths = lengths
             L = spec_.n_layers
@@ -446,7 +479,16 @@ class ContinuousEngine:
                 produced = produced + was_active.astype(jnp.int32)
                 hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
                 new_len = lengths + was_active.astype(jnp.int32)
-                done = hit_eos | (produced >= max_new) | (new_len >= cap)
+                done = (hit_eos | (produced >= max_new)
+                        | (new_len >= cap))
+                if use_stops:
+                    # device-side single-token stops ([B, K] stop-id
+                    # matrix): a stopped slot goes inactive IN-CHUNK
+                    # instead of decoding dead tokens until the host scan
+                    # sees it. Static flag: engines with no live stop ids
+                    # keep compiling the stop-free program.
+                    done = done | ((next_tok[:, None] == stop_mat)
+                                   & (stop_mat >= 0)).any(axis=-1)
                 active = was_active & ~done
                 last = jnp.where(was_active, next_tok, last)
                 emitted = jnp.where(was_active, next_tok, -1)
@@ -480,7 +522,8 @@ class ContinuousEngine:
                     # into their OWN row (clamped in-bounds) — discarded by
                     # the zero writeback count below.
                     hidden, ctx_k, ctx_v = forward_decode(
-                        spec_, params, last, lengths, ctx_k, ctx_v)
+                        spec_, params, last, lengths, ctx_k, ctx_v,
+                        fused=decode_fused)
                     logits = unembed(spec_, params, hidden)
                     next_tok, lp = sample_tokens_with_logprobs(
                         logits, sampling, step_key)
@@ -556,11 +599,13 @@ class ContinuousEngine:
                 axis=0)
             return (kp, vp, lengths, last, active, produced), packed
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        @partial(jax.jit, static_argnames=("use_stops",),
+                 donate_argnums=(1, 2, 3, 4, 5, 6))
         def _mixed_chunk(
             params, kp, vp, lengths, last_tokens, active, produced,
-            page_table, cap, max_new, sampling, eos_ids, firsts,
+            page_table, cap, max_new, sampling, eos_ids, stop_mat, firsts,
             pf_tokens, pf_ctx, pf_qlens, pf_tables, pf_sampling, key,
+            use_stops: bool = False,
         ):
             """One MIXED step: every decode slot (q<=1 rows) plus up to Rp
             in-flight prefill chunks (q=chunk rows) run through ONE
@@ -605,7 +650,11 @@ class ContinuousEngine:
             produced = produced + was_active.astype(jnp.int32)
             hit_eos = (next_tok == eos_ids) & (eos_ids >= 0)
             new_len = lengths + was_active.astype(jnp.int32)
-            done = hit_eos | (produced >= max_new) | (new_len >= cap)
+            done = (hit_eos | (produced >= max_new)
+                    | (new_len >= cap))
+            if use_stops:
+                done = done | ((next_tok[:, None] == stop_mat)
+                               & (stop_mat >= 0)).any(axis=-1)
             active = was_active & ~done
             last = jnp.where(was_active, next_tok, last_tokens)
             emitted = jnp.where(was_active, next_tok, -1)
@@ -620,9 +669,9 @@ class ContinuousEngine:
             return ((kp, vp, new_len, last, active, produced), packed,
                     pf_first)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
+        @partial(jax.jit, donate_argnums=tuple(range(11)))
         def _install(lengths, last, active, produced, max_new, eos,
-                     temps, top_k, top_p, min_p, slots, vals):
+                     temps, top_k, top_p, min_p, stops, slots, vals):
             """All per-slot state writes of a WHOLE admission round in ONE
             dispatch (eager .at[].set chains are device round-trips —
             ruinous on remote/tunnelled devices). ``slots`` is a padded
@@ -641,12 +690,13 @@ class ContinuousEngine:
                 top_k.at[i].set(vals["top_k"], **kw),
                 top_p.at[i].set(vals["top_p"], **kw),
                 min_p.at[i].set(vals["min_p"], **kw),
+                stops.at[i].set(vals["stops"], **kw),
             )
 
-        @partial(jax.jit, donate_argnums=tuple(range(11)))
+        @partial(jax.jit, donate_argnums=tuple(range(12)))
         def _install_first(lengths, last, active, produced, max_new, eos,
-                           temps, top_k, top_p, min_p, firsts_buf, slots,
-                           vals, first_dev, cols):
+                           temps, top_k, top_p, min_p, stops, firsts_buf,
+                           slots, vals, first_dev, cols):
             """Deferred-admission install: like ``_install`` but the first
             tokens stay ON DEVICE — ``first_dev`` is the prefill program's
             [2, bb] output, ``cols`` maps each row to its column in it.
@@ -672,6 +722,7 @@ class ContinuousEngine:
                 top_k.at[i].set(vals["top_k"], **kw),
                 top_p.at[i].set(vals["top_p"], **kw),
                 min_p.at[i].set(vals["min_p"], **kw),
+                stops.at[i].set(vals["stops"], **kw),
                 firsts_buf.at[:, i].set(sel, **kw),
             )
 
@@ -726,6 +777,24 @@ class ContinuousEngine:
         self.timeline: Optional[StepTimeline] = (
             StepTimeline(capacity=cap, name="continuous") if cap else None)
         self._tl_programs: set = set()
+        # host-gap split (ISSUE 5 satellite): dispatch-bracket seconds vs
+        # the host-side gap BETWEEN consecutive dispatch brackets, so an
+        # hbm_util regression is attributable at a glance — kernel-side
+        # (dispatch grew) or scheduler-side (gap grew). Counted even with
+        # the timeline ring disabled. Sync decode brackets include the
+        # blocking packed read, i.e. ≈ device-busy wall time; defer_sync
+        # brackets cover dispatch only, so its gap share reads higher —
+        # compare like with like.
+        self._dispatch_s = 0.0
+        self._host_gap_s = 0.0
+        self._last_dispatch_end: Optional[float] = None
+        # overlap hook (ISSUE 5c): called on the ENGINE thread right
+        # after each chunk/mixed dispatch, while the device is busy. The
+        # serving pump wires its inbox drain (batch formation) here so
+        # admission work rides the device step's shadow instead of the
+        # gap between steps. The hook must only enqueue (engine.submit);
+        # it must NOT call step()/install paths.
+        self.overlap_hook: Optional[Any] = None
 
     # ------------------------------------------------------------- submit
 
@@ -951,13 +1020,18 @@ class ContinuousEngine:
             ("max_new", np.int32), ("eos", np.int32),
             ("temp", np.float32), ("top_k", np.int32),
             ("top_p", np.float32), ("min_p", np.float32))}
+        stops = np.full((bb, _DEVICE_STOP_K), -1, np.int32)
         for i, r in enumerate(rows):
             slots[i] = r["slot"]
             self._lengths_host[r["slot"]] = r["prompt_len"]
+            stops[i, : len(r["stops"])] = r["stops"]
+            (self._stop_slots.add if r["stops"]
+             else self._stop_slots.discard)(r["slot"])
             for k in f:
                 f[k][i] = r[k]
-        return bb, jnp.asarray(slots), {k: jnp.asarray(v)
-                                        for k, v in f.items()}
+        vals = {k: jnp.asarray(v) for k, v in f.items()}
+        vals["stops"] = jnp.asarray(stops)
+        return bb, jnp.asarray(slots), vals
 
     def _install_device(self, rows: List[Dict[str, Any]]) -> None:
         """Install device state for a round of admissions in one dispatch;
@@ -967,10 +1041,10 @@ class ContinuousEngine:
         _bb, slots, vals = self._pack_rows(rows)
         (self._lengths, self._last, self._active, self._produced,
          self._max_new, self._eos, self._temps, self._top_k,
-         self._top_p, self._min_p) = self._install(
+         self._top_p, self._min_p, self._stops_dev) = self._install(
             self._lengths, self._last, self._active, self._produced,
             self._max_new, self._eos, self._temps, self._top_k,
-            self._top_p, self._min_p, slots, vals,
+            self._top_p, self._min_p, self._stops_dev, slots, vals,
         )
 
     def _install_device_first(self, rows: List[Dict[str, Any]],
@@ -987,12 +1061,14 @@ class ContinuousEngine:
         cols_np[: len(cols)] = cols
         (self._lengths, self._last, self._active, self._produced,
          self._max_new, self._eos, self._temps, self._top_k,
-         self._top_p, self._min_p, self._firsts_dev) = self._install_first(
+         self._top_p, self._min_p, self._stops_dev,
+         self._firsts_dev) = self._install_first(
             self._lengths, self._last, self._active, self._produced,
             self._max_new, self._eos, self._temps, self._top_k,
-            self._top_p, self._min_p, self._firsts_dev,
+            self._top_p, self._min_p, self._stops_dev, self._firsts_dev,
             slots, vals, first_dev, jnp.asarray(cols_np),
         )
+        self._firsts_host = None     # device columns rewritten: cache stale
 
     @staticmethod
     def _slot_row(req: GenerationRequest, slot: int, prompt_len: int,
@@ -1000,7 +1076,8 @@ class ContinuousEngine:
         return {"slot": slot, "prompt_len": prompt_len, "first": first,
                 "max_new": req.max_new_tokens, "eos": req.eos_id,
                 "temp": req.temperature, "top_k": req.top_k,
-                "top_p": req.top_p, "min_p": req.min_p}
+                "top_p": req.top_p, "min_p": req.min_p,
+                "stops": list(req.stop_ids or ())[:_DEVICE_STOP_K]}
 
     def _install_slot(self, req: GenerationRequest, slot: int,
                       prompt_len: int, first: int, t_dispatch: float,
@@ -1519,13 +1596,19 @@ class ContinuousEngine:
             self.params, self.kv.k_pages, self.kv.v_pages,
             self._lengths, self._last, self._active, self._produced,
             self.kv.page_table, cap, self._max_new, sampling, self._eos,
-            self._firsts_dev, jnp.asarray(pf_tokens), jnp.asarray(pf_ctx),
-            jnp.asarray(pf_qlens), jnp.asarray(pf_tables), pf_sampling, kc,
+            self._stops_dev, self._firsts_dev, jnp.asarray(pf_tokens),
+            jnp.asarray(pf_ctx), jnp.asarray(pf_qlens),
+            jnp.asarray(pf_tables), pf_sampling, kc,
+            use_stops=bool(self._stop_slots),
         )
         kp, vp, self._lengths, self._last, self._active, self._produced = \
             carry
         self.kv.swap(kp, vp)
-        self._process_packed(packed, 1, dict(self._slots), t0, cap_list)
+        # the device is busy with the dispatched step: let the serving
+        # layer form the next batch in its shadow (ISSUE 5c)
+        self._run_overlap_hook()
+        self._process_packed(packed, 1, dict(self._slots), t0, cap_list,
+                             fresh_firsts=True)
 
         # --- prefill bookkeeping, mirroring _advance_group: only the LAST
         # chunk's sample is the real first token
@@ -1581,16 +1664,28 @@ class ContinuousEngine:
 
     # ------------------------------------------------------------- finish
 
+    def _firsts_snapshot(self) -> np.ndarray:
+        """Host [2, max_slots] copy of the deferred-firsts buffer for the
+        retire-path rescues. Usually free: sync chunk processing caches
+        the copy that rode the packed read (``fresh_firsts``). When stale
+        (an install rewrote columns, or defer_sync processing lags), ONE
+        whole-buffer readback refills it — a retire wave that previously
+        paid a [2]-element round trip PER SLOT now pays at most one."""
+        if self._firsts_host is None:
+            self._firsts_host = np.asarray(self._firsts_dev)
+        return self._firsts_host
+
     def _finish(self, slot: int, reason: str) -> None:
         state = self._slots.pop(slot)
+        self._stop_slots.discard(slot)
         self.kv.free_slot(slot)
         req = state.request
         if state.first_pending:
             # retired before any packed read delivered its deferred first
             # token (e.g. capacity-retire on the very next step): rescue
-            # it with a direct read — rare, so the round trip is fine
+            # it from the batched snapshot — no per-slot round trip
             state.first_pending = False
-            fp = np.asarray(self._firsts_dev[:, slot])
+            fp = np.ascontiguousarray(self._firsts_snapshot()[:, slot])
             state.tokens.insert(0, int(fp[0]))
             state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
             state.first_token_at = time.perf_counter()
@@ -1627,9 +1722,9 @@ class ContinuousEngine:
         if state.first_pending:
             # the deferred first token lives only in the device firsts
             # buffer, which the slot's successor will overwrite — rescue
-            # it now (same direct read as _finish; swap-outs are rare)
+            # it now (same batched snapshot as _finish)
             state.first_pending = False
-            fp = np.asarray(self._firsts_dev[:, slot])
+            fp = np.ascontiguousarray(self._firsts_snapshot()[:, slot])
             state.tokens.insert(0, int(fp[0]))
             state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
             state.first_token_at = time.perf_counter()
@@ -1693,7 +1788,8 @@ class ContinuousEngine:
                 "first": state.tokens[-1], "max_new": req.max_new_tokens,
                 "eos": req.eos_id, "temp": req.temperature,
                 "top_k": req.top_k, "top_p": req.top_p,
-                "min_p": req.min_p}])
+                "min_p": req.min_p,
+                "stops": list(req.stop_ids or ())[:_DEVICE_STOP_K]}])
             # _install hard-codes produced=1 (true for admissions);
             # restore the real count — rare path, eager set acceptable
             self._produced = self._produced.at[slot].set(state.produced)
@@ -1738,6 +1834,17 @@ class ContinuousEngine:
 
     # --------------------------------------------------------------- step
 
+    def _run_overlap_hook(self) -> None:
+        """Invoke the serving layer's overlap hook (see ``__init__``) —
+        exceptions are logged, never fatal to the step."""
+        hook = self.overlap_hook
+        if hook is None:
+            return
+        try:
+            hook()
+        except Exception:
+            logger.exception("overlap hook failed")
+
     def _tl_record(self, kind: str, t0: float, program: Any = None,
                    **args: Any) -> None:
         """Append one step-timeline record (no-op when disabled).
@@ -1747,6 +1854,16 @@ class ContinuousEngine:
         paid an XLA compile (or compile-cache load). Occupancy args are
         read from cheap host mirrors so the hot path stays unmetered
         between scrapes."""
+        now = time.perf_counter()
+        # dispatch/gap accounting runs even with the ring disabled: the
+        # roofline split (bench.py) and the engine_host_* metric families
+        # depend on it, and it is two float adds per dispatch
+        self._dispatch_s += now - t0
+        if self._last_dispatch_end is not None:
+            gap = t0 - self._last_dispatch_end
+            if gap > 0:
+                self._host_gap_s += gap
+        self._last_dispatch_end = now
         tl = self.timeline
         if tl is None:
             return
@@ -1769,7 +1886,7 @@ class ContinuousEngine:
                     "host_pages", 0)
         except Exception:
             pass
-        tl.record(kind, t0, time.perf_counter() - t0, **args)
+        tl.record(kind, t0, now - t0, **args)
 
     def step(self) -> int:
         """One engine iteration: admit, advance one prefill chunk, then one
@@ -1873,10 +1990,14 @@ class ContinuousEngine:
             self.params, self.kv.k_pages, self.kv.v_pages,
             self._lengths, self._last, self._active, self._produced,
             self.kv.page_table, cap, self._max_new, sampling, self._eos,
-            self._firsts_dev, kc, n_steps=n_steps, n_ctx_pages=mpb,
+            self._stops_dev, self._firsts_dev, kc, n_steps=n_steps,
+            n_ctx_pages=mpb, use_stops=bool(self._stop_slots),
         )
         kp, vp, self._lengths, self._last, self._active, self._produced = carry
         self.kv.swap(kp, vp)
+        # the chunk is in flight: overlap serving-side batch formation
+        # with the device step (ISSUE 5c) before the blocking read below
+        self._run_overlap_hook()
 
         # snapshot at dispatch: packed columns belong to THESE _Slot
         # objects — a slot freed and re-admitted before this chunk is
@@ -1888,7 +2009,8 @@ class ContinuousEngine:
             if prev is not None:
                 self._process_packed(*prev)
         else:
-            self._process_packed(packed, n_steps, snapshot, t0, cap_list)
+            self._process_packed(packed, n_steps, snapshot, t0, cap_list,
+                                 fresh_firsts=True)
         self._tl_record("decode", t0, program=("decode", n_steps, mpb),
                         rows=len(snapshot), n_steps=n_steps)
         return (len(self._slots) + len(self._prefilling)
@@ -1896,14 +2018,19 @@ class ContinuousEngine:
 
     def _process_packed(self, packed, n_steps: int,
                         snapshot: Dict[int, _Slot], t0: float,
-                        caps: Optional[List[int]] = None) -> None:
+                        caps: Optional[List[int]] = None,
+                        fresh_firsts: bool = False) -> None:
         """Host bookkeeping of one decode chunk's packed output: append
         tokens, update the length mirror, detect host-side stops, stream,
         finish retired slots. ``snapshot`` is the slot map at dispatch —
         entries whose ``_Slot`` is no longer current are skipped.
         ``caps`` is the per-slot token-capacity array the chunk was
         dispatched with — needed to tell a PAUSED slot (device stopped at
-        the chunk's capacity grant) from a finished one."""
+        the chunk's capacity grant) from a finished one. ``fresh_firsts``
+        marks SYNC call sites, where no install can have landed between
+        dispatch and this read — the packed firsts rows are then current
+        and refresh the host cache for free (deferred processing runs a
+        chunk behind admissions, so its rows may be stale)."""
         t_read = time.perf_counter()
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
@@ -1912,6 +2039,11 @@ class ContinuousEngine:
         lengths_row = packed_np[2 * n_steps + 1].astype(np.int32)
         firsts_tok = packed_np[2 * n_steps + 2]          # deferred admissions
         firsts_lp = packed_np[2 * n_steps + 3].view(np.float32)
+        if fresh_firsts:
+            # the whole firsts buffer rode the packed read: retire-path
+            # rescues (_finish/_try_swap_out) read this copy instead of
+            # paying a per-slot device round trip (ISSUE 5 satellite)
+            self._firsts_host = packed_np[2 * n_steps + 2: 2 * n_steps + 4]
         # sync: dispatch-to-ready per chunk. defer: dispatch time would
         # span a whole unrelated host step (samples overlapping wall
         # clock), so record the actual blocking WAIT — the residue the
@@ -2175,6 +2307,15 @@ class ContinuousEngine:
             # serving metrics the reference's mock could never know
             # (SURVEY.md §5): per-request TTFT from submit, and mean decode
             # batch occupancy (live slots / max_slots per engine step)
+            # host-gap split (ISSUE 5): seconds inside dispatch brackets
+            # vs host-side gaps between them, and the gap's share of the
+            # measured wall — the at-a-glance attribution for hbm_util
+            # regressions (kernel-side vs scheduler-side)
+            "dispatch_s_total": self._dispatch_s,
+            "host_gap_s_total": self._host_gap_s,
+            "host_bubble_frac": (
+                self._host_gap_s / (self._dispatch_s + self._host_gap_s)
+                if (self._dispatch_s + self._host_gap_s) > 0 else 0.0),
             "ttft": self.ttft_stats.snapshot(),
             "batch_occupancy": (self._occupancy_sum
                                 / (self._steps * self.max_slots)

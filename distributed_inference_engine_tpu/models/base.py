@@ -264,6 +264,121 @@ def _out_proj(spec: ModelSpec, blk: Params, attn_out):
     return out
 
 
+# ------------------------------------------------ fused decode megastep
+
+# The decode-megastep variants of the three layer seams (ISSUE 5). Each
+# checks eligibility at TRACE time (plain weight, rms norm, no bias,
+# tileable shapes — ``ops.fused_decode``) and falls back to the exact
+# unfused helper chain otherwise, so quantized layers keep riding the
+# int4/int8 kernels and every ineligible shape stays bit-identical by
+# construction. The fused kernels replicate the unfused op sequence
+# bit-for-bit (see ops/fused_decode.py docstring), so ``fused=True`` is
+# a pure traffic optimization, not a numerics mode.
+
+
+def _qkv_norm(spec: ModelSpec, blk: Params, x, positions, fused: bool = False):
+    """ln1 + QKV, the norm folded into the projection when eligible.
+
+    Plain trees carry SEPARATE wq/wk/wv (``fuse_block_weights`` only
+    concatenates int4 payloads), so the common fused shape is three
+    ``norm_matmul`` launches — each recomputes the fp32 RMS scale, a
+    [B, D] VPU reduction that is noise next to its weight stream, and
+    each reproduces the unfused ``rms_norm`` bits exactly, so q/k/v
+    match the shared-norm unfused chain bit-for-bit."""
+    if fused and spec.norm != "layernorm" and blk.get("ln1_bias") is None \
+            and not (spec.use_bias or spec.qkv_bias):
+        from ..ops.fused_decode import norm_matmul, norm_matmul_wants
+
+        b, t, d = x.shape
+        x2 = x.reshape(b * t, d)
+        H, Hkv, Dh = spec.n_heads, spec.n_kv_heads, spec.head_dim
+        nm = partial(norm_matmul, x2, blk["ln1_scale"], eps=spec.norm_eps,
+                     plus_one=spec.norm_plus_one)
+        qkv = None
+        if "w_qkv" in blk:
+            # pre-fused q|k|v (a plain checkpoint that stacked them):
+            # one N = (H+2Hkv)·Dh launch
+            if norm_matmul_wants(x2, blk["w_qkv"]):
+                qkv = nm(blk["w_qkv"])
+        elif all(norm_matmul_wants(x2, blk[m]) for m in ("wq", "wk", "wv")):
+            qkv = jnp.concatenate(
+                [nm(blk["wq"]), nm(blk["wk"]), nm(blk["wv"])], axis=-1)
+        if qkv is not None:
+            qkv = qkv.reshape(b, t, -1)
+            q, k, v = jnp.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=-1)
+            q = q.reshape(b, t, H, Dh)
+            k = k.reshape(b, t, Hkv, Dh)
+            v = v.reshape(b, t, Hkv, Dh)
+            if spec.pos_emb == "rope":
+                # RoPE stays OUTSIDE the kernel: it permutes per-head
+                # lanes after the QKV split, and its operand is the [B,
+                # 1, H, Dh] activation — ~0.1% of the weight stream
+                q = apply_rope(q, positions, spec.rope_theta)
+                k = apply_rope(k, positions, spec.rope_theta)
+            return q, k, v
+    h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
+    return _qkv(spec, blk, h, positions)
+
+
+def _out_residual(spec: ModelSpec, blk: Params, attn_out, x,
+                  fused: bool = False):
+    """x + out_proj(attn), the residual folded into the projection's
+    epilogue when eligible."""
+    if fused and not spec.use_bias:
+        from ..ops.fused_decode import matmul_residual, matmul_residual_wants
+
+        b, t, h, dh = attn_out.shape
+        a2 = attn_out.reshape(b * t, h * dh)
+        if matmul_residual_wants(a2, blk["wo"]):
+            return matmul_residual(
+                a2, blk["wo"], x.reshape(b * t, -1)).reshape(x.shape)
+    return x + _out_proj(spec, blk, attn_out)
+
+
+def _mlp_residual(spec: ModelSpec, blk: Params, x, fused: bool = False):
+    """ln2 + MLP + residual -> (new_x, moe_aux). Fused: ln2 rides the
+    gate/up projection's prologue and the residual add rides the down
+    projection's epilogue — the [B, D] stream between them never
+    round-trips HBM as separate fusions."""
+    if fused and spec.norm != "layernorm" and not spec.n_experts \
+            and not spec.use_bias and spec.mlp in ("swiglu", "geglu") \
+            and blk.get("ln2_bias") is None:
+        from ..ops.fused_decode import (
+            matmul_residual,
+            matmul_residual_wants,
+            norm_matmul,
+            norm_matmul_wants,
+        )
+
+        b, t, d = x.shape
+        x2 = x.reshape(b * t, d)
+        nm = partial(norm_matmul, x2, blk["ln2_scale"], eps=spec.norm_eps,
+                     plus_one=spec.norm_plus_one)
+        gate = up = None
+        if "w_gate_up" in blk:
+            if norm_matmul_wants(x2, blk["w_gate_up"]):
+                gate, up = jnp.split(nm(blk["w_gate_up"]), 2, axis=-1)
+        elif "w_gate" in blk and norm_matmul_wants(x2, blk["w_gate"]) \
+                and norm_matmul_wants(x2, blk["w_up"]):
+            # separate gate/up (plain trees: fuse_block_weights only
+            # stacks int4 payloads) — two launches, same recomputed-norm
+            # bit-parity argument as _qkv_norm
+            gate, up = nm(blk["w_gate"]), nm(blk["w_up"])
+        if gate is not None:
+            act = (jax.nn.silu if spec.mlp == "swiglu"
+                   else partial(jax.nn.gelu, approximate=True))
+            h = act(gate.astype(jnp.float32)).astype(x.dtype) * up
+            if matmul_residual_wants(h, blk["w_down"]):
+                out = matmul_residual(h, blk["w_down"], x2)
+                return out.reshape(b, t, d), jnp.float32(0.0)
+            out = matmul_any("btf,fd->btd", h.reshape(b, t, -1),
+                             blk["w_down"])
+            return x + out, jnp.float32(0.0)
+    h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
+    m, aux = _mlp(spec, blk, h2)
+    return x + m, aux
+
+
 def embed(spec: ModelSpec, params: Params, tokens: jnp.ndarray,
           positions: jnp.ndarray) -> jnp.ndarray:
     """[B, T] tokens -> [B, T, D] activations."""
@@ -670,6 +785,8 @@ def forward_decode(
     lengths: jnp.ndarray,    # [B] current length per slot (position of `tokens`)
     cache_k: jnp.ndarray,    # [L, B, S, Hkv, Dh]
     cache_v: jnp.ndarray,    # [L, B, S, Hkv, Dh]
+    *,
+    fused: bool = False,     # decode megastep (EngineConfig.decode_fused)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step for every slot.
 
@@ -693,8 +810,8 @@ def forward_decode(
         x, ck_full, cv_full = carry
         xs_blk, l = per_layer
         blk = rebuild(xs_blk, l)
-        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
-        q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
+        q, k, v = _qkv_norm(spec, blk, x, positions,
+                            fused=fused)             # k,v: [B, 1, Hkv, Dh]
         ck_full = ck_full.at[l, batch_idx, lengths].set(
             k[:, 0].astype(ck_full.dtype))
         cv_full = cv_full.at[l, batch_idx, lengths].set(
@@ -703,10 +820,8 @@ def forward_decode(
         cv = lax.dynamic_index_in_dim(cv_full, l, axis=0, keepdims=False)
         attn = cached_attention(q, ck, cv, lengths + 1,
                                 window=spec.sliding_window)
-        x = x + _out_proj(spec, blk, attn)
-        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, _ = _mlp(spec, blk, h2)
-        x = x + m
+        x = _out_residual(spec, blk, attn, x, fused=fused)
+        x, _ = _mlp_residual(spec, blk, x, fused=fused)
         return (x, ck_full, cv_full), None
 
     n_layers = cache_k.shape[0]
@@ -733,6 +848,7 @@ def forward_decode_window(
     active: jnp.ndarray,         # [B] bool
     *,
     attn_impl: str = "auto",
+    fused: bool = False,         # decode megastep (EngineConfig.decode_fused)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step with NO pool writes: the page pools hold the frozen
     pre-chunk prefix and fresh K/V accumulates in the dense ``side``
@@ -793,8 +909,8 @@ def forward_decode_window(
         x, side_k, side_v = carry
         xs_blk, l = per_layer
         blk = rebuild(xs_blk, l)
-        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
-        q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
+        q, k, v = _qkv_norm(spec, blk, x, positions,
+                            fused=fused)             # k,v: [B, 1, Hkv, Dh]
         sk = lax.dynamic_index_in_dim(side_k, l, 0, keepdims=False)
         sv = lax.dynamic_index_in_dim(side_v, l, 0, keepdims=False)
         if fd_fw:
@@ -836,10 +952,8 @@ def forward_decode_window(
                 attn = merge_attention([prefix, window_part], dtype=q.dtype)
         side_k = lax.dynamic_update_index_in_dim(side_k, sk, l, 0)
         side_v = lax.dynamic_update_index_in_dim(side_v, sv, l, 0)
-        x = x + _out_proj(spec, blk, attn[:, None])
-        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, _ = _mlp(spec, blk, h2)
-        x = x + m
+        x = _out_residual(spec, blk, attn[:, None], x, fused=fused)
+        x, _ = _mlp_residual(spec, blk, x, fused=fused)
         return (x, side_k, side_v), None
 
     (x, side_k, side_v), _ = lax.scan(
@@ -858,6 +972,7 @@ def forward_decode_paged(
     write_mask: Optional[jnp.ndarray] = None,   # [B] bool: which slots write
     *,
     attn_impl: str = "auto",
+    fused: bool = False,      # decode megastep (EngineConfig.decode_fused)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step against the paged HBM cache (``engine/paged_kv.py``).
 
@@ -899,13 +1014,13 @@ def forward_decode_paged(
         x, kp_full, vp_full = carry
         xs_blk, l = per_layer
         blk = rebuild(xs_blk, l)
-        h = _norm(spec, x, blk["ln1_scale"], blk.get("ln1_bias"))
-        q, k, v = _qkv(spec, blk, h, positions)          # k,v: [B, 1, Hkv, Dh]
-        fused = k.shape[2] * k.shape[3]
+        q, k, v = _qkv_norm(spec, blk, x, positions,
+                            fused=fused)             # k,v: [B, 1, Hkv, Dh]
+        kv_fused = k.shape[2] * k.shape[3]
         kp_full = kp_full.at[l, phys, offset].set(
-            k[:, 0].reshape(b, fused).astype(kp_full.dtype), mode="drop")
+            k[:, 0].reshape(b, kv_fused).astype(kp_full.dtype), mode="drop")
         vp_full = vp_full.at[l, phys, offset].set(
-            v[:, 0].reshape(b, fused).astype(vp_full.dtype), mode="drop")
+            v[:, 0].reshape(b, kv_fused).astype(vp_full.dtype), mode="drop")
         kp = lax.dynamic_index_in_dim(kp_full, l, axis=0, keepdims=False)
         vp = lax.dynamic_index_in_dim(vp_full, l, axis=0, keepdims=False)
         attn = paged_attention(
@@ -913,10 +1028,8 @@ def forward_decode_paged(
             n_kv_heads=spec.n_kv_heads, impl=attn_impl,
             window=spec.sliding_window,
         )
-        x = x + _out_proj(spec, blk, attn[:, None])
-        h2 = _norm(spec, x, blk["ln2_scale"], blk.get("ln2_bias"))
-        m, _ = _mlp(spec, blk, h2)
-        x = x + m
+        x = _out_residual(spec, blk, attn[:, None], x, fused=fused)
+        x, _ = _mlp_residual(spec, blk, x, fused=fused)
         return (x, kp_full, vp_full), None
 
     n_layers = k_pages.shape[0]

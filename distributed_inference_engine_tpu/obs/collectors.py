@@ -74,6 +74,12 @@ ENGINE_TABLE = [
      "Distinct compiled mixed-step programs"),
     ("batch_occupancy", "engine_batch_occupancy", "g",
      "Mean live slots / max_slots per engine step"),
+    ("dispatch_s_total", "engine_dispatch_seconds", "c",
+     "Seconds inside device dispatch brackets (host-gap split)"),
+    ("host_gap_s_total", "engine_host_gap_seconds", "c",
+     "Host seconds between consecutive dispatch brackets"),
+    ("host_bubble_frac", "engine_host_bubble_fraction", "g",
+     "Host gap share of dispatch+gap wall (roofline split)"),
     ("speculate_k", "engine_spec_k", "g", "Draft tokens proposed per round"),
     ("draft_acceptance_rate", "engine_spec_draft_acceptance_rate", "g",
      "Accepted / proposed draft tokens"),

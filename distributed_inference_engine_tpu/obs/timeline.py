@@ -108,3 +108,32 @@ class StepTimeline:
         with open(path, "w") as f:
             json.dump(self.to_chrome_trace(events), f)
         return path
+
+
+def busy_gap_split(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Decompose a window of dispatch events into busy (inside a dispatch
+    bracket) vs gap (host time BETWEEN consecutive brackets) seconds —
+    the roofline split (ISSUE 5): ``hbm_util`` regressions attribute to
+    the kernel side when busy grew, to the scheduler/host side when gap
+    grew. Instant markers (``dur is None``) are skipped; overlapping
+    brackets clamp the gap at zero rather than going negative.
+
+    Returns busy_s, gap_s, bubble_frac = gap / (busy + gap), and the
+    event count the split was computed over."""
+    spans = sorted((e["t"], e["t"] + e["dur"]) for e in events
+                   if e.get("dur") is not None)
+    busy = 0.0
+    gap = 0.0
+    prev_end: Optional[float] = None
+    for t0, t1 in spans:
+        busy += t1 - t0
+        if prev_end is not None and t0 > prev_end:
+            gap += t0 - prev_end
+        prev_end = max(prev_end, t1) if prev_end is not None else t1
+    total = busy + gap
+    return {
+        "busy_s": busy,
+        "gap_s": gap,
+        "bubble_frac": (gap / total) if total > 0 else 0.0,
+        "n_events": len(spans),
+    }

@@ -43,7 +43,8 @@ class EnginePump:
 
     def __init__(self, engine: Any, idle_wait_s: float = 0.25,
                  error_backoff_s: float = 0.05,
-                 mixed_step_tokens: Optional[int] = None) -> None:
+                 mixed_step_tokens: Optional[int] = None,
+                 overlap_forms: bool = True) -> None:
         self.engine = engine
         self.idle_wait_s = idle_wait_s          # safety-net poll when idle
         self.error_backoff_s = error_backoff_s  # pause after a failed step
@@ -54,6 +55,20 @@ class EnginePump:
             # stretching live decodes' inter-token latency. Hand down into
             # the engine config — only the engine's _step_mixed reads it.
             engine.config.mixed_step_tokens = int(mixed_step_tokens)
+        self._overlap_admitted = 0
+        if overlap_forms and hasattr(engine, "overlap_hook"):
+            # batch-formation overlap (ISSUE 5c): the engine calls this
+            # right after dispatching a decode/mixed chunk, while the
+            # device is busy — the inbox drain (request validation,
+            # submit, prefetch probes) runs in the step's shadow instead
+            # of the host gap between steps. Thread-safe by construction:
+            # the hook fires inside engine.step(), which only ever runs
+            # on the pump thread, and _drain_inbox only touches the
+            # engine via submit()/submit_prefilled() (enqueue-only).
+            def _overlap() -> None:
+                self._overlap_admitted += self._drain_inbox()
+
+            engine.overlap_hook = _overlap
         # (request, optional handoff, optional stream cb, future, loop)
         self._inbox: List[Tuple[GenerationRequest, Any, Any, asyncio.Future,
                                 asyncio.AbstractEventLoop]] = []
@@ -260,5 +275,8 @@ class EnginePump:
             "steps": self._steps,
             "step_errors": self._step_errors,
             "inbox_depth": inbox_depth,
+            # requests admitted INSIDE a device step's shadow via the
+            # engine's overlap hook (vs the top-of-loop drain)
+            "overlap_admitted": self._overlap_admitted,
             "engine": self.engine.get_metrics(),
         }

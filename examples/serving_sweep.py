@@ -84,6 +84,8 @@ async def run_rate(pump, spec, rate, n_requests, seed, trace_sink=None):
     m0 = engine.get_metrics()
     steps0 = m0["engine_steps"]
     occ0 = m0["batch_occupancy"] * steps0 * engine.max_slots
+    dispatch0 = m0.get("dispatch_s_total", 0.0)
+    gap0 = m0.get("host_gap_s_total", 0.0)
 
     async def client(req):
         marks = []
@@ -121,6 +123,12 @@ async def run_rate(pump, spec, rate, n_requests, seed, trace_sink=None):
     d_steps = m["engine_steps"] - steps0
     occ = ((m["batch_occupancy"] * m["engine_steps"] * engine.max_slots
             - occ0) / (d_steps * engine.max_slots)) if d_steps else 0.0
+    # host-gap split over this trial's window (same delta idiom as
+    # occupancy): dispatch seconds inside device brackets vs host gap
+    # between them — same decomposition bench.py decode mode reports
+    d_dispatch = m.get("dispatch_s_total", 0.0) - dispatch0
+    d_gap = m.get("host_gap_s_total", 0.0) - gap0
+    bubble = d_gap / (d_dispatch + d_gap) if (d_dispatch + d_gap) > 0 else 0.0
     return {
         "rate": rate,
         "goodput_toks": round(sum(counts) / wall, 1),
@@ -132,6 +140,9 @@ async def run_rate(pump, spec, rate, n_requests, seed, trace_sink=None):
         "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
         "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
         "occupancy": round(occ, 3),
+        "dispatch_s": round(d_dispatch, 2),
+        "host_gap_s": round(d_gap, 2),
+        "host_bubble_frac": round(bubble, 3),
         "wall_s": round(wall, 1),
     }
 
@@ -170,7 +181,11 @@ def main():
     engine.warmup(max_new_tokens=2)
     log(f"warmup (all buckets): {time.perf_counter() - t0:.1f}s")
 
-    pump = EnginePump(engine, idle_wait_s=0.01)
+    # BENCH_OVERLAP=0 disables batch-formation overlap (engine.overlap_hook)
+    # for A/B against the top-of-loop-only inbox drain
+    pump = EnginePump(engine, idle_wait_s=0.01,
+                      overlap_forms=os.environ.get(
+                          "BENCH_OVERLAP", "1") not in ("0", ""))
     bench.prime_pump(pump, spec, bench.BATCH)
     trials = max(1, int(os.environ.get("SWEEP_TRIALS", "3")))
     rows = []
@@ -200,8 +215,9 @@ def main():
     bench.dump_obs(engine, trace_sink, "sweep", pump=pump)
 
     log("\n| offered req/s | goodput tok/s (median) | band | served | "
-        "rejected | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 | occupancy |")
-    log("|---|---|---|---|---|---|---|---|---|---|")
+        "rejected | TTFT p50 | TTFT p99 | ITL p50 | ITL p99 | occupancy | "
+        "host bubble |")
+    log("|---|---|---|---|---|---|---|---|---|---|---|")
     for r in rows:
         lo, hi = r["goodput_band"]
         log(f"| {r['rate']:g} | {r['goodput_toks']} | {lo:g}–{hi:g} | "
@@ -209,7 +225,7 @@ def main():
             f"{r['rejected']} ({r['rejection_rate']:.0%}) | "
             f"{r['ttft_p50_ms']:.0f} ms | {r['ttft_p99_ms']:.0f} ms | "
             f"{r['itl_p50_ms']:.1f} ms | {r['itl_p99_ms']:.1f} ms | "
-            f"{r['occupancy']:.2f} |")
+            f"{r['occupancy']:.2f} | {r['host_bubble_frac']:.1%} |")
 
 
 if __name__ == "__main__":
