@@ -58,7 +58,18 @@ Env knobs:
     serving mode:  BENCH_RATE (req/s Poisson, default 16),
                    BENCH_REQUESTS (default 64), BENCH_STEPS (chunk, def 16),
                    BENCH_MAX_WAITING (queue cap, default 4x slots; 0 = off),
-                   BENCH_DEADLINE_S (queue deadline shed, default 10; 0 = off)
+                   BENCH_DEADLINE_S (queue deadline shed, default 10; 0 = off),
+                   BENCH_ADMIT_MIN (hold admissions until this many waiters,
+                   default 0 = off), BENCH_ADMIT_HOLD (max admission hold
+                   seconds, default 0.25)
+    BENCH_RUNS     timed repetitions, best-of reported (default 3)
+    BENCH_DEFER    1 = defer_sync: overlap each chunk's packed readback
+                   with the next chunk's execution (serving-mode lever)
+    BENCH_MIX_EVERY / BENCH_MIX_PROMPT   mixed workload: every Nth serving
+                   request carries a BENCH_MIX_PROMPT-token prompt
+                   (default 0 = off / 2048)
+    BENCH_FORCE_CPU  1 = skip the TPU probe and emit the CPU-fallback
+                   result line (driver smoke-testing)
 """
 
 import json

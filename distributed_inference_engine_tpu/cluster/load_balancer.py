@@ -95,6 +95,9 @@ class LoadBalancer:
         self._rand = random.Random(seed)
         self._clients: Dict[str, WorkerClient] = {}
         self._health_task: Optional[asyncio.Task] = None
+        # asyncio keeps only weak refs to tasks: retain close() tasks here
+        # or they can be garbage-collected before the socket is closed
+        self._bg_tasks: set = set()
         self._running = False
         self._pick_count = 0
         self._strategies = {
@@ -140,7 +143,9 @@ class LoadBalancer:
         client = self._clients.pop(worker_id, None)
         if client is not None:
             try:
-                asyncio.get_running_loop().create_task(client.close())
+                task = asyncio.get_running_loop().create_task(client.close())
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
             except RuntimeError:
                 pass
         return stats is not None

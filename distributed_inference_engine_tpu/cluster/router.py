@@ -91,6 +91,9 @@ class Router:
         self.workers: Dict[str, WorkerInfo] = {}
         self._clients: Dict[str, WorkerClient] = {}
         self._health_task: Optional[asyncio.Task] = None
+        # asyncio keeps only weak refs to tasks: retain close() tasks here
+        # or they can be garbage-collected before the socket is closed
+        self._bg_tasks: set = set()
         self._running = False
         self._route_count = 0
         self._failover_count = 0
@@ -136,7 +139,9 @@ class Router:
             # best-effort close; caller may not be in a loop
             try:
                 loop = asyncio.get_running_loop()
-                loop.create_task(client.close())
+                task = loop.create_task(client.close())
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
             except RuntimeError:
                 pass
         return info is not None

@@ -58,6 +58,7 @@ from ..ops.sampling import (
     sample_tokens_with_logprobs,
 )
 from ..obs.timeline import StepTimeline
+from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache, page_chain_hashes
@@ -1208,6 +1209,7 @@ class ContinuousEngine:
                 first_dev = self._prefill_cached_suffix(
                     prompt, slot, n_cached, req, k0)
                 self.kv.register_prefix(slot, prompt)
+                # graftlint: ok[host-sync-hot-path] sync cached-suffix admission needs its first token now; [2,1] elements, once per admission
                 fp = np.asarray(first_dev)           # [2, 1]: token; lp bits
                 first = int(fp[0, 0])
                 first_lp = float(fp[1].view(np.float32)[0])
@@ -1314,6 +1316,7 @@ class ContinuousEngine:
             self._deferred_admissions += len(rows)
             self._install_device_first(rows, cols, first_dev)
             return
+        # graftlint: ok[host-sync-hot-path] ONE read per admission round, amortized over the whole batch (deferred path returns above)
         fp = np.asarray(first_dev)                 # [2, bb]: tokens; lp bits
         firsts = fp[0]
         first_lps = fp[1].view(np.float32)
@@ -1479,6 +1482,7 @@ class ContinuousEngine:
             # chunks' samples are discarded — their logits see a truncated
             # prompt)
             if fp is None:
+                # graftlint: ok[host-sync-hot-path] guarded by fp is None: ONE read per finished prefill group, not per row
                 fp = np.asarray(first_dev)        # [2, bb]: token; lp bits
             first = int(fp[0, i])
             first_lp = float(fp[1].view(np.float32)[i])
@@ -1623,6 +1627,7 @@ class ContinuousEngine:
                 self.kv.register_prefix(slot, prog.prompt)
             self._total_prompt_tokens += len(prog.prompt)
             if fp is None:
+                # graftlint: ok[host-sync-hot-path] guarded by fp is None: ONE read per mixed-step prefill wave, not per row
                 fp = np.asarray(pf_first)     # [2, rpb]: token; lp bits
             first = int(fp[0, i])
             first_lp = float(fp[1].view(np.float32)[i])
@@ -1672,6 +1677,7 @@ class ContinuousEngine:
         whole-buffer readback refills it — a retire wave that previously
         paid a [2]-element round trip PER SLOT now pays at most one."""
         if self._firsts_host is None:
+            # graftlint: ok[host-sync-hot-path] cache-miss refill: ONE whole-buffer read replaces a per-slot round trip (see docstring)
             self._firsts_host = np.asarray(self._firsts_dev)
         return self._firsts_host
 
@@ -1888,6 +1894,7 @@ class ContinuousEngine:
             pass
         tl.record(kind, t0, now - t0, **args)
 
+    @hot_path
     def step(self) -> int:
         """One engine iteration: admit, advance one prefill chunk, then one
         decode chunk. Returns live + mid-prefill slots after the
@@ -2032,6 +2039,7 @@ class ContinuousEngine:
         and refresh the host cache for free (deferred processing runs a
         chunk behind admissions, so its rows may be stale)."""
         t_read = time.perf_counter()
+        # graftlint: ok[host-sync-hot-path] THE designed sync point: ONE packed read per decode chunk carries tokens+lps+active+lengths+firsts
         packed_np = np.asarray(packed)   # ONE blocking read per chunk
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
         lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)

@@ -45,6 +45,7 @@ from ..ops.sampling import (
     SamplingParams,
     sample_tokens_with_logprobs,
 )
+from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .types import GenerationRequest
@@ -221,6 +222,7 @@ class PrefillEngine:
                 runs += 1
         return runs
 
+    @hot_path
     def prefill(self, requests: List[GenerationRequest]) -> List[PrefillHandoff]:
         """Run one bucketed prefill batch; one handoff per request."""
         if not requests:
@@ -263,10 +265,13 @@ class PrefillEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
             sampling, k0,
         )
+        # graftlint: ok[host-sync-hot-path] ONE first-token read per prefill batch
         fp = np.asarray(first_dev)                 # [2, bb]: tokens; lp bits
         first = fp[0]
         first_lps = fp[1].view(np.float32)
+        # graftlint: ok[host-sync-hot-path] handoff export IS a device→host bulk copy by design: the KV ships to the decode worker
         ks_np = np.asarray(jax.device_get(ks))     # [bb, L, tb, Hkv, Dh]
+        # graftlint: ok[host-sync-hot-path] second half of the same handoff export
         vs_np = np.asarray(jax.device_get(vs))
         self.prefill_stats.add(time.perf_counter() - t0)
 

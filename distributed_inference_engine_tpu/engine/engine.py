@@ -45,6 +45,7 @@ from ..ops.sampling import (
     sample_tokens_with_logprobs,
 )
 from ..obs.timeline import StepTimeline
+from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .types import (  # noqa: F401  (re-export)
     GenerationRequest,
@@ -229,6 +230,7 @@ class Engine:
 
     # ------------------------------------------------------------ generate
 
+    @hot_path
     def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
         """Run a batch of generation jobs to completion. Static-shape safe:
         pads batch and sequence dims to buckets so repeat calls hit the jit
@@ -302,6 +304,7 @@ class Engine:
         lengths = jnp.asarray(seq_lens)
         is_real = np.zeros((bb,), dtype=bool)
         is_real[:n] = True
+        # graftlint: ok[host-sync-hot-path] ONE packed first-token read per generate() batch
         first_packed_np = np.asarray(first_packed)      # ONE blocking read
         first_np = first_packed_np[0]
         first_lp_np = first_packed_np[1].view(np.float32)
@@ -352,6 +355,7 @@ class Engine:
                 self.params, ck, cv, lengths, last, active, produced,
                 max_new_j, sampling, eos_j, kc, n_steps=n_steps,
             )
+            # graftlint: ok[host-sync-hot-path] THE designed sync point: ONE packed read per n_steps-token decode chunk
             packed_np = np.asarray(packed)   # ONE blocking read per chunk
             toks_np = packed_np[:n_steps]               # [n_steps, bb]
             lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)

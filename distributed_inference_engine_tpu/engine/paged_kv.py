@@ -63,6 +63,7 @@ def page_chain_hashes(tokens, n_pages: int, page_size: int) -> List[bytes]:
     out: List[bytes] = []
     h = b""
     for i in range(n_pages):
+        # graftlint: ok[host-sync-hot-path] tokens is the host prompt list (never a device array) — host→host conversion
         chunk = np.asarray(tokens[i * page_size: (i + 1) * page_size],
                            np.int64).tobytes()
         h = hashlib.blake2b(h + chunk, digest_size=16).digest()
@@ -498,7 +499,9 @@ class PagedKVCache:
         bucket = 1 << max(0, n - 1).bit_length()
         ids = np.asarray(pages + [pages[-1]] * (bucket - n), np.int32)
         ids = jnp.asarray(ids)
+        # graftlint: ok[host-sync-hot-path] swap-out export: ONE batched whole-page read per swap event, not per step
         k = np.asarray(jax.device_get(self.k_pages[:, ids]))[:, :n]
+        # graftlint: ok[host-sync-hot-path] second half of the same batched swap-out read
         v = np.asarray(jax.device_get(self.v_pages[:, ids]))[:, :n]
         return k, v
 

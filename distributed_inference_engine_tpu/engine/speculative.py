@@ -61,6 +61,7 @@ from ..ops.sampling import (
     sample_tokens_with_logprobs,
 )
 from ..obs.timeline import StepTimeline
+from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .types import (
@@ -425,6 +426,7 @@ class SpeculativeEngine:
 
     # ------------------------------------------------------------ generate
 
+    @hot_path
     def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
         if not requests:
             return []
@@ -473,6 +475,7 @@ class SpeculativeEngine:
             jnp.asarray(tokens), jnp.asarray(seq_lens),
             sampling, k0,
         )
+        # graftlint: ok[host-sync-hot-path] ONE first-token read per batch prefill (TTFT emission point)
         fp = np.asarray(first_dev)                  # [2, bb]: tokens; lp bits
         first = fp[0]
         first_lp = fp[1].view(np.float32)
@@ -562,6 +565,7 @@ class SpeculativeEngine:
                     self.params, self.draft_params, *state,
                     max_new_j, eos_j, sampling, kr, rounds=R,
                 )
+            # graftlint: ok[host-sync-hot-path] ONE blocking read per R speculative rounds (up to R*(k+1) tokens amortize it)
             pks = np.asarray(packs)     # ONE blocking read per R rounds
             k1 = self.k + 1
             for r in range(R):

@@ -193,6 +193,7 @@ class EnginePump:
                     self.engine.abort_all()
                 except Exception:
                     logger.exception("engine abort_all failed")
+                # graftlint: ok[async-blocking-call] _run executes only on the dedicated pump thread (started in start()), never on an event loop
                 time.sleep(self.error_backoff_s)
                 continue
             if not live and not self.engine.n_waiting:

@@ -1,0 +1,21 @@
+"""Marker decorator for latency-critical dispatch entry points.
+
+``@hot_path`` is a no-op at runtime. Its job is static: it seeds
+graftlint's call-graph reachability walk (scripts/graftlint), so every
+function transitively callable from a decorated entry point is checked
+for device→host sync reads, per-request jit wrapping, and unbucketed
+shapes. Decorate the OUTERMOST per-step/per-request dispatch method of
+an engine — not internal helpers, which the walk discovers on its own.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def hot_path(fn: F) -> F:
+    """Mark ``fn`` as a serving hot-path entry point (see module doc)."""
+    fn.__graftlint_hot_path__ = True
+    return fn

@@ -77,6 +77,9 @@ class FramedRPCClient:
         self._cond = asyncio.Condition()
         self._seq = 0
         self._closed = False
+        # asyncio keeps only weak refs to tasks: retain notify tasks here
+        # or they can be garbage-collected before the waiter is woken
+        self._bg_tasks: set = set()
 
     @property
     def address(self) -> str:
@@ -142,7 +145,9 @@ class FramedRPCClient:
                 self._cond.notify()
 
         try:
-            asyncio.get_running_loop().create_task(_notify())
+            task = asyncio.get_running_loop().create_task(_notify())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         except RuntimeError:      # no running loop (teardown) — no waiters
             pass
 

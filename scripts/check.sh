@@ -34,13 +34,19 @@ if [ "${SKIP_TESTS:-0}" = "1" ]; then
     exit 0
 fi
 
-# --- stage 2a: metric-name lint ---------------------------------------
-# docs/observability.md catalog table <-> obs/collectors.CATALOG, both
-# directions (bare interpreter, no jax) — drift fails in milliseconds.
-echo "== metric-name lint (scripts/lint_metrics.py) =="
-python scripts/lint_metrics.py || rc=1
+# --- stage 2a: graftlint ----------------------------------------------
+# AST analysis of the serving stack: host-sync reads in the hot call
+# graph, jit-stability hazards, async hygiene, docs<->code drift
+# (subsumes the old scripts/lint_metrics.py check). Bare interpreter,
+# no jax — drift fails in milliseconds. Any unsuppressed finding fails;
+# NEW findings must be fixed or pragma'd with a reason, never silently
+# baselined (refreshing the baseline takes an explicit, reviewed
+# `python -m scripts.graftlint --update-baseline`).
+echo "== graftlint (python -m scripts.graftlint) =="
+python -m scripts.graftlint distributed_inference_engine_tpu bench.py \
+    || rc=1
 if [ "$rc" -ne 0 ]; then
-    echo "check.sh: metric-name lint FAILED" >&2
+    echo "check.sh: graftlint FAILED" >&2
     exit "$rc"
 fi
 

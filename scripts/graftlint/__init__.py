@@ -1,0 +1,37 @@
+"""graftlint: AST-based static analysis for the serving stack.
+
+Pure-stdlib (``ast`` + ``json``) — importable on a bare interpreter, no
+jax required. Four rule families target this codebase's measured failure
+modes (docs/static_analysis.md has the catalog with rationale):
+
+- **hot-path**  host-blocking reads (``np.asarray`` / ``jax.device_get``
+  / ``.item()`` / ``block_until_ready``) reachable from the dispatch
+  entry points marked ``@hot_path`` — the bug class PR 5's
+  ``_firsts_snapshot`` fix hunted by hand.
+- **jit**       silent-recompile hazards: bad ``static_argnames``,
+  jit-wrapping inside loops or the hot graph, unbucketed dynamic shapes
+  that bypass ``_next_bucket``/``_pow2_buckets``.
+- **async**     blocking calls lexically inside ``async def`` (and
+  ``time.sleep`` anywhere in the serving-plane modules), unawaited
+  coroutines, fire-and-forget ``create_task`` without a retained ref.
+- **drift**     docs↔code: metrics catalog vs docs/observability.md
+  (the old scripts/lint_metrics.py check), EngineConfig/BENCH_* knobs vs
+  README + bench.py docstring, package imports vs requirements.txt.
+
+Suppression: ``# graftlint: ok[rule-id] reason`` on (or directly above)
+the flagged line — the reason string is mandatory — or an entry in the
+committed ``scripts/graftlint_baseline.json`` (refresh only via
+``--update-baseline``).
+
+Usage: ``python -m scripts.graftlint distributed_inference_engine_tpu/``
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    all_rules,
+    lint_paths,
+    lint_source,
+)
+
+__version__ = "1.0"

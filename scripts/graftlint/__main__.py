@@ -1,0 +1,78 @@
+"""CLI: ``python -m scripts.graftlint [paths...]``.
+
+Exit 0 iff every finding is suppressed (pragma or baseline). The
+baseline is append-forbidden by default: new findings FAIL the run and
+the only way to accept them wholesale is the loud ``--update-baseline``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from .core import (BASELINE_DEFAULT, Baseline, all_rules, build_project,
+                   format_json, format_text, run_rules, suppress,
+                   unsuppressed)
+
+DEFAULT_PATHS = ["distributed_inference_engine_tpu", "bench.py"]
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based hot-path / jit-stability / async-hygiene / "
+                    "drift analyzer for the serving stack")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files or directories (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relpaths + drift rules "
+                         "(default: cwd)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline file (default: scripts/"
+                         "graftlint_baseline.json); 'none' disables")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="REWRITE the baseline to accept every current "
+                         "unsuppressed finding — loud, reviewed, deliberate")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid:26s} [{rule.family}/{rule.severity}] {rule.doc}")
+        return 0
+
+    paths = args.paths or DEFAULT_PATHS
+    root = os.path.abspath(args.root or os.getcwd())
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    project = build_project(paths, root)
+    findings = run_rules(project, rules)
+    baseline_path = None if args.baseline == "none" else args.baseline
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+    findings = suppress(project, findings, baseline)
+    live = unsuppressed(findings)
+
+    if args.update_baseline:
+        if not baseline_path:
+            print("graftlint: --update-baseline needs a baseline path",
+                  file=sys.stderr)
+            return 2
+        n = Baseline.write(baseline_path, live)
+        print(f"graftlint: BASELINE UPDATED — {baseline_path} now accepts "
+              f"{n} finding(s). Review the diff before committing.")
+        return 0
+
+    if args.format == "json":
+        print(format_json(findings))
+    else:
+        print(format_text(findings, len(project.modules)))
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Rule family 3: async-hygiene for the serving control plane.
+
+ROADMAP items 1–3 (fleet serving, elastic respawn, sub-chunk streaming)
+all add asyncio control-plane code around the jitted core. A single
+blocking call on the event loop stalls EVERY in-flight RPC — the exact
+failure shape the coordinator/worker layer is designed to avoid — and an
+un-retained ``create_task`` can be garbage-collected mid-flight
+(documented asyncio footgun). These rules keep the seams honest:
+
+- ``async-blocking-call``: a known-blocking call (``time.sleep``,
+  ``subprocess.run``, sync socket/HTTP helpers, ``os.system``) lexically
+  inside ``async def`` anywhere; additionally, ``time.sleep`` in SYNC
+  code of the serving-plane modules (api/, cluster/, serving/,
+  utils/rpc.py) — those modules host event loops, so a sleep must prove
+  (pragma) it only ever runs on a dedicated thread;
+- ``async-unawaited-coroutine``: calling an ``async def`` defined in the
+  analyzed set as a bare statement — the coroutine is created, never
+  scheduled, and dies with a RuntimeWarning at GC time;
+- ``async-orphan-task``: ``create_task(...)`` whose Task object is
+  dropped on the floor — keep a reference (asyncio only holds a weak
+  one) or the task can vanish mid-flight.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from . import callgraph as cg
+from .core import Finding, ModuleInfo, Project, Rule, register
+
+# modules that host event loops: time.sleep here needs justification even
+# outside async def (it might run ON the loop via a sync helper)
+SERVING_PLANE = ("/api/", "/cluster/", "/serving/")
+SERVING_PLANE_FILES = ("utils/rpc.py",)
+
+# (root name or None, attr name) -> label; None root = any receiver
+_BLOCKING = {
+    ("time", "sleep"): "time.sleep",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "call"): "subprocess.call",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("subprocess", "Popen"): "subprocess.Popen",
+    ("os", "system"): "os.system",
+    ("os", "popen"): "os.popen",
+    ("socket", "create_connection"): "socket.create_connection",
+    ("requests", "get"): "requests.get",
+    ("requests", "post"): "requests.post",
+    ("requests", "request"): "requests.request",
+    ("urllib", "urlopen"): "urllib.request.urlopen",
+}
+
+
+def _in_serving_plane(relpath: str) -> bool:
+    return any(part in relpath for part in SERVING_PLANE) or \
+        any(relpath.endswith(f) for f in SERVING_PLANE_FILES)
+
+
+def _blocking_label(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        root = cg._expr_root_name(fn)
+        label = _BLOCKING.get((root, fn.attr))
+        if label:
+            return label
+        if fn.attr == "urlopen":
+            return "urlopen"
+    return ""
+
+
+def _async_functions(mod: ModuleInfo) -> List[ast.AsyncFunctionDef]:
+    if mod.tree is None:
+        return []
+    return [n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.AsyncFunctionDef)]
+
+
+@register
+class AsyncBlockingCall(Rule):
+    id = "async-blocking-call"
+    family = "async"
+    severity = "error"
+    doc = ("blocking call inside async def (stalls every coroutine on the "
+           "loop), or time.sleep in sync code of a serving-plane module "
+           "(must pragma-prove it runs on a dedicated thread)")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> Iterable[Finding]:
+        if mod.tree is None:
+            return ()
+        out: List[Finding] = []
+        async_spans: Set[int] = set()
+        for fn in _async_functions(mod):
+            for node in cg.iter_own_nodes(fn):
+                if isinstance(node, ast.Call):
+                    label = _blocking_label(node)
+                    if label:
+                        async_spans.add(node.lineno)
+                        out.append(self.finding(
+                            mod, node.lineno,
+                            f"{label} inside `async def {fn.name}` blocks "
+                            f"the event loop — use asyncio.sleep / "
+                            f"run_in_executor / an async client"))
+        if _in_serving_plane(mod.relpath):
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        _blocking_label(node) == "time.sleep" and \
+                        node.lineno not in async_spans:
+                    out.append(self.finding(
+                        mod, node.lineno,
+                        "time.sleep in a serving-plane module: if this "
+                        "can run on the event loop it stalls every "
+                        "in-flight RPC — make it loop-safe or pragma the "
+                        "thread it runs on"))
+        return out
+
+
+@register
+class AsyncUnawaitedCoroutine(Rule):
+    id = "async-unawaited-coroutine"
+    family = "async"
+    severity = "error"
+    doc = ("coroutine function called as a bare statement: never "
+           "scheduled, silently dropped at GC (RuntimeWarning at best)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = cg.build_call_graph(project)
+        out: List[Finding] = []
+        for fi in graph.funcs:
+            for node in cg.iter_own_nodes(fi.node):
+                if not (isinstance(node, ast.Expr)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                fn = node.value.func
+                # only trust bare-name and self.method resolution here:
+                # the unique-name fallback would misattribute common
+                # method names (executor.shutdown ≠ WorkerService.shutdown)
+                if not (isinstance(fn, ast.Name) or
+                        (isinstance(fn, ast.Attribute)
+                         and isinstance(fn.value, ast.Name)
+                         and fn.value.id == "self")):
+                    continue
+                callee = graph.resolve_call(node.value, fi)
+                if callee is not None and \
+                        isinstance(callee.node, ast.AsyncFunctionDef):
+                    out.append(self.finding(
+                        fi.mod, node.lineno,
+                        f"`{callee.name}` is async but called without "
+                        f"await/create_task in `{fi.name}` — the "
+                        f"coroutine is never scheduled"))
+        return out
+
+
+@register
+class AsyncOrphanTask(Rule):
+    id = "async-orphan-task"
+    family = "async"
+    severity = "error"
+    doc = ("create_task result dropped: asyncio keeps only a weak ref, so "
+           "the task can be garbage-collected mid-flight — retain it "
+           "(instance attr / task-set with done-callback discard)")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> Iterable[Finding]:
+        if mod.tree is None:
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in ("create_task",
+                                                 "ensure_future")):
+                out.append(self.finding(
+                    mod, node.lineno,
+                    "fire-and-forget create_task: the Task object is "
+                    "dropped and may be collected before it runs to "
+                    "completion — retain a reference"))
+        return out

@@ -1,0 +1,294 @@
+"""Shared flow analyses: hot-path call graph + host-array taint.
+
+The call graph is best-effort static resolution over the analyzed file
+set — sound enough to SEED a reachability walk, not a full type
+inference:
+
+- bare names resolve to same-module functions, then to ``from x import
+  y`` targets inside the set;
+- ``self.m(...)`` resolves to methods of the enclosing class (same
+  module);
+- any other ``obj.m(...)`` resolves only when exactly ONE function named
+  ``m`` exists across the whole analyzed set (unique-name fallback —
+  how ``self.kv.sync_tiers()`` reaches ``paged_kv.PagedKVCache``).
+
+Unresolvable calls (jitted closures stored on ``self``, stdlib, jax) are
+simply not traversed — they cannot contain host-side Python anyway.
+
+Host taint is a tiny per-function forward dataflow used to tell a
+host→host ``np.asarray(list)`` from a device→host read: names assigned
+from ``np.*`` calls, list/tuple literals, comprehensions, or
+subscripts/attribute chains of already-host names are "host"; so are
+names matching the repo's ``*_np`` / ``*_host`` mirror convention.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import ModuleInfo, Project
+
+HOT_DECORATOR = "hot_path"
+HOST_NAME_SUFFIXES = ("_np", "_host")
+
+
+class FuncInfo:
+    """One function/method definition in the analyzed set."""
+
+    def __init__(self, mod: ModuleInfo, node: ast.AST,
+                 cls: Optional[str]) -> None:
+        self.mod = mod
+        self.node = node
+        self.cls = cls                       # enclosing class name or None
+        self.name = node.name
+        self.qual = (f"{mod.relpath}::{cls}.{node.name}" if cls
+                     else f"{mod.relpath}::{node.name}")
+        self.is_hot_seed = any(_decorator_name(d) == HOT_DECORATOR
+                               for d in node.decorator_list)
+
+
+def _decorator_name(d: ast.AST) -> str:
+    if isinstance(d, ast.Call):
+        d = d.func
+    if isinstance(d, ast.Attribute):
+        return d.attr
+    if isinstance(d, ast.Name):
+        return d.id
+    return ""
+
+
+class CallGraph:
+    def __init__(self, project: Project) -> None:
+        self.funcs: List[FuncInfo] = []
+        self.by_qual: Dict[str, FuncInfo] = {}
+        # (module, class|None, name) -> FuncInfo
+        self._exact: Dict[Tuple[str, Optional[str], str], FuncInfo] = {}
+        self._by_name: Dict[str, List[FuncInfo]] = {}
+        # per-module: imported name -> (source module relpath guess, name)
+        self._imports: Dict[str, Dict[str, str]] = {}
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            self._imports[mod.relpath] = _from_imports(mod.tree)
+            for node, cls in _iter_functions(mod.tree):
+                fi = FuncInfo(mod, node, cls)
+                self.funcs.append(fi)
+                self.by_qual[fi.qual] = fi
+                self._exact[(mod.relpath, cls, fi.name)] = fi
+                self._by_name.setdefault(fi.name, []).append(fi)
+
+    # ------------------------------------------------------- resolution
+
+    def resolve_call(self, call: ast.Call, caller: FuncInfo
+                     ) -> Optional[FuncInfo]:
+        fn = call.func
+        mod = caller.mod.relpath
+        if isinstance(fn, ast.Name):
+            hit = self._exact.get((mod, None, fn.id))
+            if hit is not None:
+                return hit
+            # ``from .engine import _next_bucket`` style: the imported name
+            # resolves by unique-name across the set
+            if fn.id in self._imports.get(mod, {}):
+                return self._unique(fn.id)
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                hit = self._exact.get((mod, caller.cls, fn.attr))
+                if hit is not None:
+                    return hit
+            return self._unique(fn.attr)
+        return None
+
+    def _unique(self, name: str) -> Optional[FuncInfo]:
+        cands = self._by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    # ----------------------------------------------------- reachability
+
+    def hot_reachable(self) -> Set[str]:
+        """Qualified names reachable from ``@hot_path`` seeds."""
+        seeds = [f for f in self.funcs if f.is_hot_seed]
+        seen: Set[str] = set()
+        work = list(seeds)
+        while work:
+            f = work.pop()
+            if f.qual in seen:
+                continue
+            seen.add(f.qual)
+            for call in _iter_calls(f.node):
+                callee = self.resolve_call(call, f)
+                if callee is not None and callee.qual not in seen:
+                    work.append(callee)
+        return seen
+
+
+def build_call_graph(project: Project) -> CallGraph:
+    return project.cached("callgraph", lambda p: CallGraph(p))
+
+
+def hot_reachable(project: Project) -> Set[str]:
+    return project.cached(
+        "hot_reachable", lambda p: build_call_graph(p).hot_reachable())
+
+
+# ------------------------------------------------------------- traversal
+
+def _iter_functions(tree: ast.Module
+                    ) -> Iterable[Tuple[ast.AST, Optional[str]]]:
+    """(def node, enclosing class name) for every function, at any depth.
+    Nested defs report the OUTER class context (closures inside a method
+    still belong to its class for ``self`` resolution)."""
+
+    def walk(node: ast.AST, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def _iter_calls(fn: ast.AST) -> Iterable[ast.Call]:
+    """Calls lexically inside ``fn``, NOT descending into nested defs
+    (a closure is its own FuncInfo; traced functions never run on host)."""
+    for node in iter_own_nodes(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def iter_own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """All AST nodes in ``fn``'s own body, excluding nested function/class
+    bodies (their findings belong to their own scope). Decorators run at
+    DEF time, so a nested def's decorators belong to the ENCLOSING scope
+    and ``fn``'s own decorators don't belong to ``fn`` at all."""
+    own_decs = set(map(id, getattr(fn, "decorator_list", []) or []))
+    stack = [c for c in ast.iter_child_nodes(fn) if id(c) not in own_decs]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            continue
+        if isinstance(node, (ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _from_imports(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+# ------------------------------------------------------------ host taint
+
+_HOST_ROOT_MODULES = ("np", "numpy")
+_HOST_BUILTINS = ("len", "sorted", "list", "tuple", "dict", "range", "zip",
+                  "enumerate", "min", "max", "sum", "int", "float", "str")
+
+
+def _expr_root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a Name/Attribute/Subscript/Call chain."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _attr_chain_tail(node: ast.AST) -> Optional[str]:
+    """Final attribute name of ``a.b.c`` (→ "c"), else None."""
+    return node.attr if isinstance(node, ast.Attribute) else None
+
+
+def looks_host_name(name: str) -> bool:
+    return name.endswith(HOST_NAME_SUFFIXES)
+
+
+def host_tainted_names(fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` that provably hold HOST data (see module doc)."""
+    tainted: Set[str] = set()
+    for a in fn.args.args + fn.args.kwonlyargs:
+        ann = a.annotation        # host-container / ndarray annotations
+        if ann is not None and any(
+                t in ast.dump(ann) for t in
+                ("ndarray", "'List'", "'Sequence'", "'Tuple'", "'Dict'",
+                 "'list'", "'tuple'", "'dict'")):
+            tainted.add(a.arg)
+
+    def value_is_host(v: ast.AST) -> bool:
+        if isinstance(v, ast.Constant):
+            # a bare None is a sentinel, not data: `pending = None` must
+            # not taint a name later rebound to device results
+            return v.value is not None
+        if isinstance(v, (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                          ast.ListComp, ast.DictComp, ast.SetComp,
+                          ast.JoinedStr)):
+            return True
+        if isinstance(v, ast.BinOp):
+            return value_is_host(v.left) and value_is_host(v.right)
+        if isinstance(v, ast.Call):
+            root = _expr_root_name(v.func)
+            if root in _HOST_ROOT_MODULES:            # np.anything(...)
+                return True
+            if isinstance(v.func, ast.Name) and \
+                    v.func.id in _HOST_BUILTINS:
+                return True
+            # methods of a host value stay host (fp[1].view(np.float32))
+            if isinstance(v.func, ast.Attribute):
+                return value_is_host(v.func.value)
+            return False
+        if isinstance(v, (ast.Subscript, ast.Attribute)):
+            tail = _attr_chain_tail(v)
+            if tail is not None and looks_host_name(tail):
+                return True
+            return value_is_host(v.value)
+        if isinstance(v, ast.Name):
+            return v.id in tainted or looks_host_name(v.id)
+        return False
+
+    # two passes ≈ fixpoint for the straight-line assignment chains the
+    # hot paths actually contain
+    for _ in range(2):
+        for node in iter_own_nodes(fn):
+            if isinstance(node, ast.Assign) and value_is_host(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                tainted.add(el.id)
+    return tainted
+
+
+def expr_is_host(node: ast.AST, tainted: Set[str]) -> bool:
+    """Is this expression host data under the taint set / naming rules?"""
+    if isinstance(node, (ast.List, ast.Tuple, ast.ListComp, ast.Constant,
+                         ast.GeneratorExp)):
+        return True
+    if isinstance(node, ast.BinOp):     # list + pad*k concatenation idiom
+        return expr_is_host(node.left, tainted) and \
+            expr_is_host(node.right, tainted)
+    root = _expr_root_name(node)
+    if root is not None and (root in tainted or looks_host_name(root)):
+        return True
+    tail = _attr_chain_tail(node)
+    if tail is not None and looks_host_name(tail):
+        return True
+    if isinstance(node, (ast.Subscript, ast.Attribute)):
+        return expr_is_host(node.value, tainted)
+    return False

@@ -1,0 +1,374 @@
+"""graftlint framework: findings, pragmas, baseline, rule registry, runner.
+
+Rules come in two shapes:
+
+- **module rules** run once per analyzed file against its ``ast`` tree;
+- **project rules** run once per invocation against the whole
+  :class:`Project` (cross-file checks: call-graph reachability, docs↔code
+  drift, requirements coverage).
+
+Both yield :class:`Finding`. The runner then applies the two suppression
+layers — inline ``# graftlint: ok[rule] reason`` pragmas and the committed
+baseline file — and whatever survives fails the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+BASELINE_DEFAULT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "graftlint_baseline.json")
+
+SEVERITIES = ("error", "warn")
+
+# ``# graftlint: ok[rule-a,rule-b] reason text`` — the bracket may list
+# several rule ids or ``*``; everything after the bracket is the reason.
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*ok\[([A-Za-z0-9_\-, *]+)\]\s*(.*?)\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, "/"-separated
+    line: int
+    message: str
+    severity: str = "error"
+    # baseline identity: the stripped source line (stable across pure
+    # line-number shifts), or the message itself for file-less findings
+    key: str = ""
+    suppressed_by: Optional[str] = None   # None | "pragma" | "baseline"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.severity}] "
+                f"{self.rule}: {self.message}")
+
+
+class Pragmas:
+    """Per-file pragma index. A pragma suppresses matching findings on its
+    own line and — when the pragma is the whole line (a comment line) — on
+    the next line as well."""
+
+    def __init__(self, source: str, path: str = "<src>") -> None:
+        self.path = path
+        # line no -> (set of rule ids or {"*"}, reason)
+        self.at: Dict[int, Tuple[set, str]] = {}
+        self._own_line: set = set()      # pragmas that are a whole line
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.at[i] = (rules, m.group(2))
+            if text.lstrip().startswith("#"):
+                self._own_line.add(i)
+
+    def lookup(self, rule: str, line: int) -> Optional[Tuple[int, str]]:
+        """Pragma line + reason covering ``rule`` at ``line``, if any."""
+        for cand in (line, line - 1):
+            entry = self.at.get(cand)
+            if entry is None:
+                continue
+            if cand == line - 1 and cand not in self._own_line:
+                continue                  # trailing pragma binds its own line
+            rules, reason = entry
+            if rule in rules or "*" in rules:
+                return cand, reason
+        return None
+
+    def reasonless(self) -> List[int]:
+        return [ln for ln, (_r, reason) in sorted(self.at.items())
+                if not reason]
+
+
+class ModuleInfo:
+    """One parsed source file."""
+
+    def __init__(self, relpath: str, source: str,
+                 tree: Optional[ast.Module], error: Optional[str]) -> None:
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.error = error                # syntax error text, if any
+        self.pragmas = Pragmas(source, self.relpath)
+
+    def line_key(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()[:160]
+        return ""
+
+
+class Project:
+    """The analyzed file set plus repo-level context for cross-file rules."""
+
+    def __init__(self, root: str, modules: Sequence[ModuleInfo]) -> None:
+        self.root = os.path.abspath(root)
+        self.modules = list(modules)
+        self._cache: Dict[str, object] = {}   # shared analysis results
+
+    def module(self, relpath: str) -> Optional[ModuleInfo]:
+        relpath = relpath.replace(os.sep, "/")
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        p = os.path.join(self.root, relpath)
+        if not os.path.exists(p):
+            return None
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+
+    def cached(self, name: str, build: Callable[["Project"], object]):
+        if name not in self._cache:
+            self._cache[name] = build(self)
+        return self._cache[name]
+
+
+# --------------------------------------------------------------- registry
+
+class Rule:
+    """Base: subclass, set the class attrs, implement one of the hooks."""
+
+    id: str = ""
+    family: str = ""
+    severity: str = "error"
+    doc: str = ""
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+    # helper: finding anchored to a module line, key auto-derived
+    def finding(self, mod: ModuleInfo, line: int, message: str,
+                key: str = "") -> Finding:
+        return Finding(rule=self.id, path=mod.relpath, line=line,
+                       message=message, severity=self.severity,
+                       key=key or mod.line_key(line) or message)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate + register a Rule subclass."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rule_modules()
+    return dict(_REGISTRY)
+
+
+_LOADED = False
+
+
+def _load_rule_modules() -> None:
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from . import async_rules    # noqa: F401
+    from . import drift_rules    # noqa: F401
+    from . import hotpath_rules  # noqa: F401
+    from . import import_rules   # noqa: F401
+    from . import jit_rules      # noqa: F401
+
+
+# --------------------------------------------------------------- baseline
+
+class Baseline:
+    """Committed accepted-findings ledger: (rule, path, key) multiset.
+
+    Keys are stripped source lines, so pure line-number churn doesn't
+    invalidate entries; editing a flagged line does, on purpose.
+    """
+
+    def __init__(self, entries: Iterable[Dict[str, str]] = ()) -> None:
+        self.counts: Dict[Tuple[str, str, str], int] = {}
+        for e in entries:
+            k = (e["rule"], e["path"], e["key"])
+            self.counts[k] = self.counts.get(k, 0) + 1
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("entries", []))
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding]) -> int:
+        entries = sorted(
+            ({"rule": f.rule, "path": f.path, "key": f.key}
+             for f in findings),
+            key=lambda e: (e["path"], e["rule"], e["key"]))
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1,
+                       "comment": "accepted pre-existing graftlint findings;"
+                                  " refresh ONLY via --update-baseline",
+                       "entries": entries}, f, indent=1)
+            f.write("\n")
+        return len(entries)
+
+    def consume(self, f: Finding) -> bool:
+        k = (f.rule, f.path, f.key)
+        n = self.counts.get(k, 0)
+        if n <= 0:
+            return False
+        self.counts[k] = n - 1
+        return True
+
+
+# ---------------------------------------------------------------- running
+
+def _collect_files(paths: Sequence[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif ap.endswith(".py"):
+            out.append(ap)
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+def build_project(paths: Sequence[str], root: Optional[str] = None,
+                  ) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    modules: List[ModuleInfo] = []
+    for fp in _collect_files(paths, root):
+        rel = os.path.relpath(fp, root)
+        try:
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            modules.append(ModuleInfo(rel, "", None, str(e)))
+            continue
+        try:
+            tree = ast.parse(src, filename=rel)
+            modules.append(ModuleInfo(rel, src, tree, None))
+        except SyntaxError as e:
+            modules.append(ModuleInfo(rel, src, None, str(e)))
+    return Project(root, modules)
+
+
+def run_rules(project: Project,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """All raw findings, before pragma/baseline suppression."""
+    reg = all_rules()
+    active = [reg[r] for r in rules] if rules else list(reg.values())
+    findings: List[Finding] = []
+    for mod in project.modules:
+        if mod.error is not None:
+            findings.append(Finding(
+                rule="parse-error", path=mod.relpath, line=1,
+                message=f"cannot parse: {mod.error}", key=mod.error))
+            continue
+        for rule in active:
+            findings.extend(rule.check_module(mod, project))
+    for rule in active:
+        findings.extend(rule.check_project(project))
+    # a pragma with no reason is itself a finding: suppressions must say WHY
+    for mod in project.modules:
+        for ln in mod.pragmas.reasonless():
+            findings.append(Finding(
+                rule="pragma-missing-reason", path=mod.relpath, line=ln,
+                message="graftlint pragma without a reason string — every "
+                        "ok[...] must justify itself",
+                key=mod.line_key(ln)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def suppress(project: Project, findings: Sequence[Finding],
+             baseline: Optional[Baseline] = None) -> List[Finding]:
+    """Mark findings covered by a pragma or the baseline (in that order)."""
+    baseline = baseline or Baseline()
+    for f in findings:
+        if f.rule == "pragma-missing-reason":
+            continue                      # not pragma-suppressible
+        mod = project.module(f.path)
+        if mod is not None and mod.pragmas.lookup(f.rule, f.line):
+            f.suppressed_by = "pragma"
+        elif baseline.consume(f):
+            f.suppressed_by = "baseline"
+    return list(findings)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               rules: Optional[Sequence[str]] = None,
+               baseline_path: Optional[str] = None) -> List[Finding]:
+    project = build_project(paths, root)
+    findings = run_rules(project, rules)
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+    return suppress(project, findings, baseline)
+
+
+def lint_source(source: str, relpath: str = "fixture.py",
+                rules: Optional[Sequence[str]] = None,
+                root: Optional[str] = None) -> List[Finding]:
+    """Test/fixture entry: lint one in-memory module (pragmas honored, no
+    baseline). Project-level rules run too, seeing only this module; the
+    default root is a non-existent dir so repo-level drift rules no-op."""
+    try:
+        tree: Optional[ast.Module] = ast.parse(source, filename=relpath)
+        err = None
+    except SyntaxError as e:
+        tree, err = None, str(e)
+    mod = ModuleInfo(relpath, source, tree, err)
+    project = Project(root or os.path.join(os.getcwd(),
+                                           "__graftlint_fixture__"), [mod])
+    findings = run_rules(project, rules)
+    return suppress(project, findings)
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if f.suppressed_by is None]
+
+
+def format_text(findings: Sequence[Finding], n_files: int) -> str:
+    live = unsuppressed(findings)
+    out = [f.format() for f in live]
+    n_pragma = sum(1 for f in findings if f.suppressed_by == "pragma")
+    n_base = sum(1 for f in findings if f.suppressed_by == "baseline")
+    out.append(f"graftlint: {len(live)} finding(s) "
+               f"({n_pragma} pragma-suppressed, {n_base} baseline-suppressed)"
+               f" across {n_files} file(s)")
+    return "\n".join(out)
+
+
+def format_json(findings: Sequence[Finding]) -> str:
+    return json.dumps([f.to_dict() for f in findings], indent=1)

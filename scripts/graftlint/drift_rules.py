@@ -1,0 +1,257 @@
+"""Rule family 4: docs↔code drift.
+
+``drift-metrics-docs`` generalizes scripts/lint_metrics.py (now a shim
+over this rule): the docs/observability.md catalog table and
+``obs/collectors.CATALOG`` must agree in both directions, kinds
+included.
+
+``drift-knob-docs`` is the sibling check for the serving knobs: every
+``EngineConfig.<field>``-style reference in README.md / docs/*.md must
+name a real field of the config dataclasses (stale docs), and every
+``BENCH_*`` env var bench.py actually reads must be documented in
+README.md or bench.py's own docstring — and vice versa (phantom knobs).
+
+Both are project rules: they anchor findings to the drifted file, keyed
+by the drifted NAME (stable under unrelated edits).
+"""
+
+from __future__ import annotations
+
+import ast
+import binascii
+import importlib
+import os
+import re
+import sys
+import types
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Rule, register
+
+PKG = "distributed_inference_engine_tpu"
+OBS_DOC = "docs/observability.md"
+CONFIG_PY = f"{PKG}/config.py"
+COLLECTORS_PY = f"{PKG}/obs/collectors.py"
+
+# a docs catalog row: | `family_name` | kind | labels | help |
+_ROW_RE = re.compile(
+    r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)`\s*\|\s*(counter|gauge|histogram)\s*\|")
+# knob references in prose: `EngineConfig.prefill_chunk` etc.
+_KNOB_REF_RE = re.compile(
+    r"`(EngineConfig|BatcherConfig|CacheConfig|HealthConfig|ServerConfig|"
+    r"ModelConfig|MeshConfig|MultihostConfig)\.([a-z_][a-z0-9_]*)")
+_BENCH_RE = re.compile(r"\bBENCH_[A-Z0-9_]+\b")
+
+
+def _find_line(text: str, needle: str) -> int:
+    for i, line in enumerate(text.splitlines(), start=1):
+        if needle in line:
+            return i
+    return 1
+
+
+# ------------------------------------------------------------- metrics
+
+def load_catalog(root: str) -> Optional[Dict[str, str]]:
+    """Import obs.collectors.CATALOG (jax-free by contract) from ``root``.
+
+    The import runs under a per-root ALIAS package, not the real package
+    name: the hosting process (pytest, a REPL) may already have the real
+    ``distributed_inference_engine_tpu`` imported, and a sys.modules hit
+    on the real name would silently return THAT catalog instead of the
+    one in the tree being linted. The alias stubs only carry ``__path__``
+    so relative imports inside obs/ resolve within ``root``."""
+    pkg_dir = os.path.join(root, PKG)
+    if not os.path.isfile(os.path.join(pkg_dir, "obs", "collectors.py")):
+        return None
+    alias = "_graftlint_catalog_%08x" % (
+        binascii.crc32(os.path.abspath(root).encode()) & 0xFFFFFFFF)
+    try:
+        mod = sys.modules.get(alias + ".obs.collectors")
+        if mod is None:
+            for name, path in ((alias, pkg_dir),
+                               (alias + ".obs", os.path.join(pkg_dir, "obs"))):
+                stub = types.ModuleType(name)
+                stub.__path__ = [path]
+                sys.modules.setdefault(name, stub)
+            importlib.invalidate_caches()   # root may be a fresh tmp dir
+            mod = importlib.import_module(alias + ".obs.collectors")
+        catalog = mod.CATALOG
+    except Exception:
+        return None
+    return {name: kind for name, (kind, _l, _h) in catalog.items()}
+
+
+def check_metrics_drift(root: str) -> List[Finding]:
+    """Two-way catalog↔docs diff; plain-function entry so the
+    scripts/lint_metrics.py shim can call it without the runner."""
+    out: List[Finding] = []
+
+    def mk(path: str, line: int, msg: str, key: str) -> Finding:
+        return Finding(rule="drift-metrics-docs", path=path, line=line,
+                       message=msg, key=key)
+
+    doc_path = os.path.join(root, OBS_DOC)
+    if not os.path.exists(doc_path):
+        return [mk(OBS_DOC, 1, f"{OBS_DOC} missing", "missing-doc")]
+    cat = load_catalog(root)
+    if cat is None:
+        return [mk(COLLECTORS_PY, 1,
+                   "cannot import obs.collectors.CATALOG", "no-catalog")]
+    with open(doc_path, encoding="utf-8") as f:
+        doc_text = f.read()
+    doc: Dict[str, str] = {}
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        m = _ROW_RE.match(line)
+        if m:
+            doc[m.group(1)] = m.group(2)
+    col_text = ""
+    col_path = os.path.join(root, COLLECTORS_PY)
+    if os.path.exists(col_path):
+        with open(col_path, encoding="utf-8") as f:
+            col_text = f.read()
+    for name in sorted(set(cat) - set(doc)):
+        out.append(mk(COLLECTORS_PY, _find_line(col_text, f'"{name}"'),
+                      f"metric family {name} ({cat[name]}) is emitted but "
+                      f"undocumented in {OBS_DOC}", name))
+    for name in sorted(set(doc) - set(cat)):
+        out.append(mk(OBS_DOC, _find_line(doc_text, f"`{name}`"),
+                      f"metric family {name} is documented but no "
+                      f"collector emits it (stale row)", name))
+    for name in sorted(set(doc) & set(cat)):
+        if doc[name] != cat[name]:
+            out.append(mk(OBS_DOC, _find_line(doc_text, f"`{name}`"),
+                          f"metric family {name} documented as "
+                          f"{doc[name]} but the catalog says {cat[name]}",
+                          name))
+    return out
+
+
+@register
+class DriftMetricsDocs(Rule):
+    id = "drift-metrics-docs"
+    family = "drift"
+    severity = "error"
+    doc = ("docs/observability.md catalog table and obs/collectors.CATALOG "
+           "must agree both ways, kinds included (ex scripts/"
+           "lint_metrics.py)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        # only meaningful against the real repo tree
+        if not os.path.exists(os.path.join(project.root, COLLECTORS_PY)):
+            return ()
+        return check_metrics_drift(project.root)
+
+
+# --------------------------------------------------------------- knobs
+
+def _config_fields(root: str) -> Optional[Dict[str, Set[str]]]:
+    """class name -> field names, parsed from config.py's AST (no import:
+    this must work with zero deps installed)."""
+    path = os.path.join(root, CONFIG_PY)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        fields: Set[str] = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                fields.add(stmt.target.id)
+        out[node.name] = fields
+    return out
+
+
+def _bench_reads(root: str) -> Tuple[Set[str], str, str]:
+    """(env names bench.py reads, its docstring, full source)."""
+    path = os.path.join(root, "bench.py")
+    if not os.path.exists(path):
+        return set(), "", ""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src)
+    reads: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("BENCH_") and \
+                _BENCH_RE.fullmatch(node.value):
+            reads.add(node.value)
+    docstring = ast.get_docstring(tree) or ""
+    return reads, docstring, src
+
+
+def check_knob_drift(root: str) -> List[Finding]:
+    out: List[Finding] = []
+
+    def mk(path: str, line: int, msg: str, key: str) -> Finding:
+        return Finding(rule="drift-knob-docs", path=path, line=line,
+                       message=msg, key=key)
+
+    fields = _config_fields(root)
+    doc_files = ["README.md"]
+    docs_dir = os.path.join(root, "docs")
+    if os.path.isdir(docs_dir):
+        doc_files += sorted(
+            os.path.join("docs", f) for f in os.listdir(docs_dir)
+            if f.endswith(".md"))
+    # 1) prose references to config fields must name real fields
+    if fields is not None:
+        for rel in doc_files:
+            p = os.path.join(root, rel)
+            if not os.path.exists(p):
+                continue
+            with open(p, encoding="utf-8") as f:
+                text = f.read()
+            for i, line in enumerate(text.splitlines(), start=1):
+                for m in _KNOB_REF_RE.finditer(line):
+                    cls, field = m.group(1), m.group(2)
+                    if cls in fields and field not in fields[cls]:
+                        out.append(mk(
+                            rel, i,
+                            f"doc references {cls}.{field} but config.py "
+                            f"defines no such field — stale knob doc",
+                            f"{cls}.{field}"))
+    # 2) BENCH_* two-way: reads vs README + bench.py docstring
+    reads, docstring, bench_src = _bench_reads(root)
+    if reads:
+        readme_path = os.path.join(root, "README.md")
+        readme = ""
+        if os.path.exists(readme_path):
+            with open(readme_path, encoding="utf-8") as f:
+                readme = f.read()
+        documented = set(_BENCH_RE.findall(readme)) | \
+            set(_BENCH_RE.findall(docstring))
+        for name in sorted(reads - documented):
+            out.append(mk("bench.py", _find_line(bench_src, f'"{name}"'),
+                          f"{name} is read by bench.py but documented "
+                          f"neither in its docstring nor in README.md",
+                          name))
+        for name in sorted(documented - reads):
+            where = "README.md" if name in _BENCH_RE.findall(readme) \
+                else "bench.py"
+            src = readme if where == "README.md" else bench_src
+            out.append(mk(where, _find_line(src, name),
+                          f"{name} is documented but bench.py never reads "
+                          f"it — phantom knob", name))
+    return out
+
+
+@register
+class DriftKnobDocs(Rule):
+    id = "drift-knob-docs"
+    family = "drift"
+    severity = "error"
+    doc = ("EngineConfig-family field references in README/docs must exist "
+           "in config.py; BENCH_* env vars must be documented iff read by "
+           "bench.py")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        if not os.path.exists(os.path.join(project.root, CONFIG_PY)) and \
+                not os.path.exists(os.path.join(project.root, "bench.py")):
+            return ()
+        return check_knob_drift(project.root)

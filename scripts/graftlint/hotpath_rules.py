@@ -1,0 +1,86 @@
+"""Rule family 1: host-blocking reads inside the dispatch hot path.
+
+Motivating bug (docs/static_analysis.md, docs/decode_profile.md r10): two
+per-slot ``np.asarray`` first-token reads inside the continuous engine's
+dispatch loop cost a measurable host bubble per chunk — found by hand in
+PR 5 and fixed with the batched ``_firsts_snapshot``. This rule makes the
+class un-reintroducible: every device→host sync reachable from a
+``@hot_path``-decorated dispatch entry point must be batched, moved off
+the hot path, or pragma-justified (e.g. "ONE blocking read per chunk").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from . import callgraph as cg
+from .core import Finding, ModuleInfo, Project, Rule, register
+
+# attribute-call syncs: receiver doesn't matter, the attr name does
+_SYNC_ATTRS = {
+    "device_get": "jax.device_get",
+    "block_until_ready": ".block_until_ready()",
+}
+
+
+def _sync_call_kind(call: ast.Call) -> str:
+    """Non-empty label when ``call`` is a device→host sync candidate."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        root = cg._expr_root_name(fn)
+        if fn.attr == "asarray" and root in ("np", "numpy"):
+            return "np.asarray"
+        if fn.attr in _SYNC_ATTRS:
+            return _SYNC_ATTRS[fn.attr]
+        if fn.attr == "item" and not call.args and not call.keywords:
+            return ".item()"
+    return ""
+
+
+@register
+class HostSyncHotPath(Rule):
+    id = "host-sync-hot-path"
+    family = "hot-path"
+    severity = "error"
+    doc = ("device→host blocking read (np.asarray / jax.device_get / "
+           ".item() / block_until_ready, or int()/float() over one) in a "
+           "function reachable from a @hot_path dispatch entry point")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = cg.build_call_graph(project)
+        hot = cg.hot_reachable(project)
+        out: List[Finding] = []
+        for fi in graph.funcs:
+            if fi.qual not in hot:
+                continue
+            tainted = cg.host_tainted_names(fi.node)
+            for node in cg.iter_own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _sync_call_kind(node)
+                if kind == "np.asarray" and node.args and \
+                        cg.expr_is_host(node.args[0], tainted):
+                    continue    # host→host conversion, not a device read
+                if kind:
+                    out.append(self._mk(fi, node, kind))
+                    continue
+                # int(...)/float(...) wrapping a sync call: the compound
+                # form of the same read
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("int", "float") and node.args:
+                    inner = node.args[0]
+                    if isinstance(inner, ast.Call) and \
+                            _sync_call_kind(inner):
+                        out.append(self._mk(
+                            fi, node,
+                            f"{node.func.id}() over a device read"))
+        return out
+
+    def _mk(self, fi: cg.FuncInfo, node: ast.AST, kind: str) -> Finding:
+        mod: ModuleInfo = fi.mod
+        return self.finding(
+            mod, node.lineno,
+            f"{kind} in hot-path function `{fi.name}` (reachable from a "
+            f"@hot_path dispatch entry): batch it, move it off the step "
+            f"path, or pragma it with the amortization argument")

@@ -1,72 +1,33 @@
 #!/usr/bin/env python
-"""Metric-name lint: ``docs/observability.md`` catalog table vs
-``obs/collectors.CATALOG``, both directions.
+"""Metric-name lint — thin shim over graftlint's ``drift-metrics-docs``.
 
-Every family the collectors can emit must be documented, every documented
-family must still exist, and the documented kind must match. Runs on a
-bare interpreter: the top-level package is stubbed so importing
-``obs.collectors`` (jax-free by contract) doesn't pull the serving stack.
+The two-way docs/observability.md ↔ obs/collectors.CATALOG check now
+lives in scripts/graftlint/drift_rules.py (with kind-mismatch detection
+and file:line anchors). This wrapper keeps the old entry point and exit
+semantics for existing callers; prefer
+``python -m scripts.graftlint --rules drift-metrics-docs``.
 
 Usage: python scripts/lint_metrics.py   (exit 1 on any drift)
 """
 
 import os
-import re
 import sys
-import types
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = "distributed_inference_engine_tpu"
 sys.path.insert(0, ROOT)
-_pkg = types.ModuleType(PKG)
-_pkg.__path__ = [os.path.join(ROOT, PKG)]
-sys.modules.setdefault(PKG, _pkg)
 
-from distributed_inference_engine_tpu.obs.collectors import (  # noqa: E402
-    CATALOG,
-)
-
-DOC = os.path.join(ROOT, "docs", "observability.md")
-
-# a catalog row: | `family_name` | kind | labels | help |
-_ROW_RE = re.compile(
-    r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)`\s*\|\s*(counter|gauge|histogram)\s*\|")
-
-
-def doc_rows(path):
-    rows = {}
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            m = _ROW_RE.match(line)
-            if m:
-                rows[m.group(1)] = m.group(2)
-    return rows
+from scripts.graftlint.drift_rules import check_metrics_drift  # noqa: E402
+from scripts.graftlint.drift_rules import load_catalog  # noqa: E402
 
 
 def main() -> int:
-    if not os.path.exists(DOC):
-        print(f"lint_metrics: {DOC} missing", file=sys.stderr)
-        return 1
-    doc = doc_rows(DOC)
-    cat = {name: kind for name, (kind, _labels, _help) in CATALOG.items()}
-    rc = 0
-    for name in sorted(set(cat) - set(doc)):
-        print(f"lint_metrics: {name} ({cat[name]}) is in the collector "
-              "catalog but undocumented in docs/observability.md",
-              file=sys.stderr)
-        rc = 1
-    for name in sorted(set(doc) - set(cat)):
-        print(f"lint_metrics: {name} is documented but no collector emits "
-              "it (stale docs/observability.md row)", file=sys.stderr)
-        rc = 1
-    for name in sorted(set(doc) & set(cat)):
-        if doc[name] != cat[name]:
-            print(f"lint_metrics: {name} documented as {doc[name]} but the "
-                  f"catalog says {cat[name]}", file=sys.stderr)
-            rc = 1
-    if rc == 0:
+    findings = check_metrics_drift(ROOT)
+    for f in findings:
+        print(f"lint_metrics: {f.format()}", file=sys.stderr)
+    if not findings:
+        cat = load_catalog(ROOT) or {}
         print(f"lint_metrics: {len(cat)} families in sync")
-    return rc
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

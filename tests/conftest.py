@@ -54,6 +54,9 @@ def pytest_configure(config):
         "markers", "obs: observability tests (metrics registry, step "
         "timeline, trace propagation; fast leg: pytest -m 'obs and not "
         "slow')")
+    config.addinivalue_line(
+        "markers", "lint: graftlint static-analysis tests (rule fixtures, "
+        "pragma/baseline mechanics, zero-findings gate on the real tree)")
 
 
 def pytest_pyfunc_call(pyfuncitem):
