@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import asyncio
 import copy
+import dataclasses
 import logging
+import random
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -47,31 +49,24 @@ from ..cluster.worker import (
     request_from_dict,
     result_to_dict,
 )
+from ..engine.types import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    GenerationResult,
+)
 from ..obs import collectors as obs_collectors
 from ..obs.registry import MetricsRegistry
 from ..serving.batcher import PAD_INPUT, Batcher
 from ..serving.cache import ResponseCache
+# typed failure taxonomy (utils/errors.py): TRANSPORT_ERRORS ⇒ health
+# signal + retry elsewhere; shed_reason reads the envelope's error_detail
+# structurally — "queue_full" (retry elsewhere now) vs "deadline" (the
+# request aged out) vs "draining" (the worker is retiring; any other
+# replica can take it). Application errors propagate untouched.
+from ..utils.errors import REASON_DRAINING, TRANSPORT_ERRORS, shed_reason
 from ..utils.tracing import RequestTrace, new_request_id
 
 logger = logging.getLogger(__name__)
-
-
-def _shed_reason(exc) -> str:
-    """Shed reason of a relayed overloaded RPC error: structurally from
-    the envelope's ``error_detail`` (``RPCError.detail``, carried from the
-    engine's ``rpc_error_detail``), with a message-text fallback for peers
-    predating the field — clients distinguish "queue_full" (retry
-    elsewhere now) from "deadline" (the request aged out; shorten
-    timeouts)."""
-    detail = getattr(exc, "detail", "")
-    if detail:
-        return detail
-    return "deadline" if "deadline" in str(exc) else "queue_full"
-
-# transport-level trouble ⇒ health signal + retry; application errors
-# (WorkerRPCError) propagate to the caller untouched
-_TRANSPORT_ERRORS = (OSError, ConnectionError, asyncio.TimeoutError,
-                     asyncio.IncompleteReadError, EOFError)
 
 
 @dataclass
@@ -82,6 +77,17 @@ class CoordinatorConfig:
     lb_strategy: str = LoadBalancerStrategy.ROUND_ROBIN.value
     dispatch_timeout_s: float = 120.0
     cache_enabled: bool = True
+    # retry budget: how many RE-dispatches a failed batch/stream gets
+    # (transport failures and draining sheds only — queue_full sheds keep
+    # the one-alternate contract and deadlines never retry), each preceded
+    # by exponential backoff with jitter so a mass failover doesn't
+    # thundering-herd the survivors
+    max_dispatch_retries: int = 3
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 1.0
+    retry_jitter_frac: float = 0.25
+    retry_seed: Optional[int] = None      # None ⇒ nondeterministic jitter
+    drain_timeout_s: float = 30.0         # default budget for drain_worker
 
     @classmethod
     def from_config(cls, cfg: Config) -> "CoordinatorConfig":
@@ -131,6 +137,7 @@ class Coordinator:
                         allow_pickle=self.config.cache.persist_allow_pickle)
                     logger.info("restored %d cache entries from %s",
                                 n, persist)
+                # graftlint: ok[swallowed-transport-error] local persistence, no peer involved; a cold cache is the documented fallback
                 except Exception:
                     logger.exception("cache restore from %s failed — "
                                      "starting cold", persist)
@@ -143,6 +150,13 @@ class Coordinator:
         self._cache_hits = 0
         self._submitted = 0
         self._overload_rejections = 0   # worker sheds seen (typed error)
+        self._dispatch_retries = 0      # re-dispatches (transport/draining)
+        self._stream_resumes = 0        # mid-stream failovers with replay
+        self._deadline_expired = 0      # client-visible deadline outcomes
+        self._drains = 0                # graceful worker drains completed
+        # seeded jitter source for retry backoff (retry_seed pins it for
+        # reproducible chaos runs)
+        self._retry_rand = random.Random(self.config.retry_seed)
         self._model_configs: Dict[str, ModelConfig] = {}
         self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
         # disaggregated deployments: model -> (prefill worker ids, rr cursor)
@@ -185,9 +199,37 @@ class Coordinator:
         self.lb.register_worker(worker_id, host, port, **metadata)
 
     def remove_worker(self, worker_id: str) -> bool:
+        """Immediate removal from both planes. Unregistering aborts the
+        pooled clients' in-flight calls so anything queued against this
+        worker fails fast as a transport error and requeues through the
+        retry budget — instead of timing out against a gone target. For a
+        graceful exit use ``drain_worker``."""
         a = self.router.unregister_worker(worker_id)
         b = self.lb.unregister_worker(worker_id)
         return a or b
+
+    async def drain_worker(self, worker_id: str,
+                           timeout_s: Optional[float] = None,
+                           remove: bool = True) -> Dict[str, Any]:
+        """Gracefully retire a worker: quarantine it in the LB (breaker
+        force-open, so spreading stops immediately), issue the ``drain``
+        verb (the worker stops admitting — new work gets the typed
+        ``draining`` shed, which the retry budget moves to another replica
+        — and finishes its in-flight requests), then unregister it from
+        both planes. Returns the worker's drain summary (per-model
+        KV/prefix/token counters) so the caller can account for what the
+        worker was holding."""
+        if timeout_s is None:
+            timeout_s = self.config.drain_timeout_s
+        self.lb.quarantine(worker_id)
+        client = (self.router.client_for(worker_id)
+                  if worker_id in self.router.workers
+                  else self.lb.client_for(worker_id))
+        summary = await client.drain(timeout_s=timeout_s)
+        self._drains += 1
+        if remove:
+            self.remove_worker(worker_id)
+        return summary
 
     async def deploy_model(
         self,
@@ -320,6 +362,7 @@ class Coordinator:
         request_id: Optional[str] = None,
         no_cache: bool = False,
         text: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, Any]:
         """One generation request, end to end. Returns a result dict
         (``result_to_dict`` schema) plus trace/cache metadata.
@@ -328,6 +371,14 @@ class Coordinator:
         (``README.md:96-98``): the coordinator tokenizes it host-side
         (``utils/tokenizer.py``) and the result carries a detokenized
         ``"text"`` field alongside the raw tokens.
+
+        ``deadline_s`` is an end-to-end budget in seconds. The coordinator
+        spends part of it queueing in the batcher (an expired request is
+        rejected before any dispatch), forwards the REMAINDER in the
+        request so the worker's engine sheds it from its own queue rather
+        than spending decode steps on an answer nobody is waiting for, and
+        raises the typed ``DeadlineExceededError`` on expiry. Deadline
+        outcomes are never retried — the budget is gone wherever it runs.
         """
         if not self._running:
             raise RuntimeError("coordinator is not running")
@@ -383,22 +434,30 @@ class Coordinator:
             "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
             "request_id": request_id,
             "key": affinity,
-            # the live trace rides the batcher input so _run_batch can mark
-            # routing/dispatch phases and merge the worker-side spans; it is
-            # a coordinator-local key — request_from_dict ignores it and it
-            # never crosses the wire
+            "deadline_s": deadline_s,
+            # coordinator-local keys (request_from_dict ignores them, they
+            # never cross the wire): the live trace so _run_batch can mark
+            # routing/dispatch phases and merge the worker-side spans, and
+            # _t0 anchoring the deadline budget at submission time
             "trace": trace,
+            "_t0": time.monotonic(),
         }
         future = await self.batcher.add_request(
             model, version, inputs, request_id=request_id, trace=trace
         )
         result: Dict[str, Any] = await future
+        if result.get("finish_reason") == "deadline":
+            # typed outcome, never cached, never retried: the budget is
+            # spent whether it expired in our batcher queue, the worker's
+            # engine queue, or mid-decode
+            self._deadline_expired += 1
+            raise DeadlineExceededError(
+                f"request {request_id} deadline ({deadline_s}s) expired "
+                "before completion", request_id=request_id)
         if result.get("finish_reason") == "overloaded":
             # client-visible typed outcome (VERDICT r2 item 2): every
             # replica the dispatch tried shed this request — the caller
             # must back off, and the outcome must never enter the cache
-            from ..engine.types import EngineOverloadedError
-
             raise EngineOverloadedError(
                 f"request {request_id} shed by every tried replica "
                 f"({result.get('metadata', {}).get('overload_reason', '?')})"
@@ -435,12 +494,20 @@ class Coordinator:
         key: Optional[str] = None,
         request_id: Optional[str] = None,
         text: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, Any]:
         """Streaming variant of ``submit``: ``on_tokens(tokens)`` fires as
         the worker decodes. Bypasses the response cache and the batcher —
         a streaming request is dispatched immediately on its own (it still
         shares the worker's rolling decode batch with everything else).
-        Not yet supported on disaggregated deployments."""
+        Not yet supported on disaggregated deployments.
+
+        A worker dying MID-stream is no longer terminal: the coordinator
+        resumes on an alternate replica by replaying prompt + the already-
+        delivered prefix as the new prompt (greedy decode is a pure
+        function of context, so the continuation is token-for-token what
+        the dead worker would have produced) — the caller's ``on_tokens``
+        never sees a duplicate or a gap."""
         if not self._running:
             raise RuntimeError("coordinator is not running")
         if model in self._disagg:
@@ -476,58 +543,126 @@ class Coordinator:
             "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
             "request_id": request_id,
         })
-        delivered = 0
+        delivered: List[int] = []
         cb = on_tokens or (lambda toks: None)
 
         def counting_cb(toks):
-            nonlocal delivered
-            delivered += len(toks)
+            delivered.extend(toks)
             cb(toks)
 
         trace.mark("dispatched")
-        try:
-            result = await self._stream_once(model, worker_id, req,
-                                             counting_cb)
-        except _TRANSPORT_ERRORS:
-            # retry on an alternate worker — but only while NOTHING has
-            # streamed to the caller yet (a restart would replay tokens)
-            if delivered:
-                raise
-            alt = self._pick_alternate(model, version, worker_id,
-                                       affinity, sharded)
-            if alt is None:
-                raise
-            logger.warning("stream dispatch to %s failed — retrying on %s",
-                           worker_id, alt)
-            worker_id = alt
-            result = await self._stream_once(model, worker_id, req,
-                                             counting_cb)
-        except WorkerRPCError as e:
-            # streaming shed: same contract as the batch path (one
-            # alternate, then the typed error + counter). Today sheds
-            # happen at admission, before anything streams; if one ever
-            # arrives after tokens were delivered we can't retry (a
-            # restart would replay tokens) but the caller still gets the
-            # typed backoff signal, counted.
-            if getattr(e, "kind", "") != "overloaded":
-                raise
-            from ..engine.types import EngineOverloadedError
-
-            if delivered:
-                self._overload_rejections += 1
-                raise EngineOverloadedError(
-                    f"request {request_id} shed after {delivered} tokens "
-                    "streamed; the stream cannot be resumed — back off "
-                    "and retry", reason=_shed_reason(e)) from e
-            alt = self._pick_alternate(model, version, worker_id,
-                                       affinity, sharded)
-            if alt is not None:
+        t0 = time.monotonic()
+        tried = {worker_id}
+        attempt = 0
+        while True:
+            prefix = len(delivered)
+            remaining_budget: Optional[float] = None
+            if deadline_s is not None:
+                remaining_budget = deadline_s - (time.monotonic() - t0)
+                if remaining_budget <= 0:
+                    self._deadline_expired += 1
+                    raise DeadlineExceededError(
+                        f"request {request_id} deadline ({deadline_s}s) "
+                        "expired before completion", request_id=request_id)
+            if prefix and max_new_tokens - prefix <= 0:
+                # the stream died delivering its very last token — nothing
+                # left to generate, so synthesize the final result from
+                # what already streamed
+                result = GenerationResult(
+                    request_id=request_id, tokens=list(delivered),
+                    finish_reason="length", prompt_tokens=len(prompt),
+                    metadata={"stream_resumed": attempt})
+                break
+            # resume: replay prompt + delivered prefix as the new prompt;
+            # greedy decode continues with exactly the tokens the dead
+            # worker would have produced next
+            run_req = dataclasses.replace(
+                req,
+                prompt=(list(prompt) + list(delivered)) if prefix
+                else list(prompt),
+                max_new_tokens=max_new_tokens - prefix,
+                deadline_s=remaining_budget)
+            try:
+                result = await self._stream_once(model, worker_id, run_req,
+                                                 counting_cb)
+            except TRANSPORT_ERRORS as e:
+                alt = (None if attempt >= self.config.max_dispatch_retries
+                       else self._pick_alternate(model, version, worker_id,
+                                                 affinity, sharded,
+                                                 exclude=tried))
+                if alt is None:
+                    raise
+                tried.add(alt)
+                attempt += 1
+                self._dispatch_retries += 1
+                if delivered:
+                    self._stream_resumes += 1
+                    logger.warning(
+                        "stream to %s died after %d tokens (%s) — resuming "
+                        "on %s with prefix replay", worker_id,
+                        len(delivered), type(e).__name__, alt)
+                else:
+                    logger.warning("stream dispatch to %s failed (%s) — "
+                                   "retrying on %s", worker_id,
+                                   type(e).__name__, alt)
+                delay = self._retry_backoff_s(attempt - 1)
+                if delay:
+                    await asyncio.sleep(delay)
+                worker_id = alt
+                continue
+            except WorkerRPCError as e:
+                kind = getattr(e, "kind", "")
+                if kind == "deadline":
+                    # the worker's engine expired it in-queue: typed
+                    # outcome, never retried
+                    self._deadline_expired += 1
+                    raise DeadlineExceededError(
+                        f"request {request_id} deadline expired before "
+                        "completion", request_id=request_id) from e
+                if kind != "overloaded":
+                    raise
+                reason = shed_reason(e)
+                if reason == REASON_DRAINING:
+                    # admission refused while the worker retires — nothing
+                    # streamed on THIS attempt (draining rejects before
+                    # admission), so any other replica can take it, even
+                    # mid-resume
+                    alt = (None
+                           if attempt >= self.config.max_dispatch_retries
+                           else self._pick_alternate(model, version,
+                                                     worker_id, affinity,
+                                                     sharded, exclude=tried))
+                    if alt is not None:
+                        tried.add(alt)
+                        attempt += 1
+                        self._dispatch_retries += 1
+                        logger.info("worker %s draining — moving stream "
+                                    "to %s", worker_id, alt)
+                        worker_id = alt
+                        continue
+                # queue_full (or draining with nowhere to go): one
+                # alternate, then the typed error + counter — the batch
+                # path's contract
+                if delivered:
+                    self._overload_rejections += 1
+                    raise EngineOverloadedError(
+                        f"request {request_id} shed after {len(delivered)} "
+                        "tokens streamed; back off and retry",
+                        reason=reason) from e
+                alt = self._pick_alternate(model, version, worker_id,
+                                           affinity, sharded, exclude=tried)
+                if alt is None:
+                    self._overload_rejections += 1
+                    raise EngineOverloadedError(
+                        f"request {request_id} shed ({e}); back off and "
+                        "retry", reason=reason) from e
+                tried.add(alt)
                 logger.info("stream shed by %s — retrying on %s",
                             worker_id, alt)
                 try:
                     worker_id = alt
-                    result = await self._stream_once(model, worker_id, req,
-                                                     counting_cb)
+                    result = await self._stream_once(model, worker_id,
+                                                     run_req, counting_cb)
                 except WorkerRPCError as e2:
                     if getattr(e2, "kind", "") != "overloaded":
                         raise
@@ -535,12 +670,15 @@ class Coordinator:
                     raise EngineOverloadedError(
                         f"request {request_id} shed by every tried "
                         "replica; back off and retry",
-                        reason=_shed_reason(e2)) from e2
-            else:
-                self._overload_rejections += 1
-                raise EngineOverloadedError(
-                    f"request {request_id} shed ({e}); back off and "
-                    "retry", reason=_shed_reason(e)) from e
+                        reason=shed_reason(e2)) from e2
+            if prefix:
+                # the resumed worker only saw the continuation — stitch
+                # the full token sequence (matching what streamed) and the
+                # original prompt length back together
+                result.tokens = list(delivered[:prefix]) + list(result.tokens)
+                result.prompt_tokens = len(prompt)
+                result.metadata["stream_resumed"] = attempt
+            break
         trace.mark("done")
         out = result_to_dict(result)
         out["cached"] = False
@@ -636,7 +774,38 @@ class Coordinator:
             groups[picked.worker_id] = list(range(len(reals)))
 
         async def run_group(worker_id: str, idxs: List[int]) -> None:
-            reqs = [request_from_dict(reals[i]) for i in idxs]
+            # deadline gate BEFORE dispatch: a request whose budget expired
+            # while queued in the batcher is answered locally — no RPC, no
+            # decode step, typed "deadline" outcome. Survivors carry the
+            # REMAINING budget so the worker's engine can expire them from
+            # its own queue.
+            now = time.monotonic()
+            live: List[int] = []
+            for i in idxs:
+                inp = reals[i]
+                dl = inp.get("deadline_s")
+                if dl is not None and now - inp.get("_t0", now) >= dl:
+                    results[i] = {
+                        "request_id": inp["request_id"], "tokens": [],
+                        "finish_reason": "deadline",
+                        "prompt_tokens": len(inp["prompt"]), "logprobs": [],
+                        "ttft_s": 0.0, "decode_s": 0.0,
+                        "metadata": {"deadline_s": dl,
+                                     "expired": "coordinator_queue"},
+                    }
+                    continue
+                live.append(i)
+            if not live:
+                return
+            idxs = live
+            reqs = []
+            for i in idxs:
+                req = request_from_dict(reals[i])
+                if req.deadline_s is not None:
+                    req.deadline_s = max(
+                        0.0, req.deadline_s
+                        - (now - reals[i].get("_t0", now)))
+                reqs.append(req)
             for i in idxs:
                 self._trace_mark(reals[i], "dispatched")
             try:
@@ -674,6 +843,7 @@ class Coordinator:
                                      for i in shed])
                     for i, out in zip(shed, retry_outs):
                         results[i] = out
+                # graftlint: ok[swallowed-transport-error] _dispatch_once already dented the alternate's LB/router health before raising; surfacing the original typed shed is the one-alternate contract
                 except Exception:
                     logger.warning("shed-retry on %s failed — surfacing "
                                    "the original overloaded outcome", alt)
@@ -691,84 +861,133 @@ class Coordinator:
             self._merge_worker_trace(inp, out)
         return results  # aligned with the real inputs, pads dropped
 
+    def _retry_backoff_s(self, attempt: int) -> float:
+        """Exponential backoff with jitter for re-dispatch ``attempt``
+        (0-based): ``min(max, base·2^attempt)·(1 + jitter·U[0,1))``. The
+        jitter source is seeded by ``retry_seed`` so chaos runs reproduce."""
+        base = self.config.retry_backoff_base_s
+        if base <= 0:
+            return 0.0
+        delay = min(self.config.retry_backoff_max_s, base * (2 ** attempt))
+        return delay * (1.0 + self.config.retry_jitter_frac
+                        * self._retry_rand.random())
+
     async def _dispatch_with_retry(
         self, model: str, version: str, worker_id: str,
         reqs: List, keys: List[str], sharded: bool,
     ) -> List[Dict[str, Any]]:
-        try:
-            return await self._dispatch_once(model, worker_id, reqs)
-        except _TRANSPORT_ERRORS as e:
-            # _dispatch_once already marked the failure — don't double-count
-            logger.warning("dispatch to %s failed (%s: %s) — retrying on "
-                           "alternate", worker_id, type(e).__name__, e)
-            if model in self._disagg:
-                # disaggregated: the failure was the (stateless) prefill
-                # worker, already marked; re-dispatch re-picks from the
-                # healthy remainder — decode target unchanged
-                return await self._dispatch_once(model, worker_id, reqs)
-            alt = self._pick_alternate(model, version, worker_id,
-                                       keys[0], sharded)
-            if alt is None:
-                raise
-            return await self._dispatch_once(model, alt, reqs)
-        except WorkerRPCError as e:
-            # disaggregated relay reporting its decode peer down: the
-            # decode worker was already marked in _dispatch_disagg_once —
-            # retry once on an alternate decode shard
+        """Budgeted dispatch. Transport failures, dead decode peers and
+        ``draining`` sheds retry on an UNTRIED replica with exponential
+        backoff + jitter, at most ``max_dispatch_retries`` re-dispatches.
+        ``queue_full`` sheds keep the one-alternate contract — an
+        overloaded worker is busy, not broken, and retry loops would only
+        move the overload around the fleet. Application errors (and
+        deadline outcomes, which come back as per-request results) never
+        retry."""
+        tried = {worker_id}
+        wid = worker_id
+        attempt = 0
+        while True:
+            try:
+                return await self._dispatch_once(model, wid, reqs)
+            except TRANSPORT_ERRORS as e:
+                # _dispatch_once already marked the failure — don't
+                # double-count health here
+                err: Exception = e
+            except WorkerRPCError as e:
+                kind = getattr(e, "kind", "")
+                if (model in self._disagg
+                        and kind == DECODE_PEER_UNREACHABLE):
+                    # disaggregated relay reporting its decode peer down:
+                    # the decode worker was already marked in
+                    # _dispatch_disagg_once — move to an alternate shard
+                    err = e
+                elif kind == "overloaded" and shed_reason(e) == REASON_DRAINING:
+                    # a draining worker refused admission while finishing
+                    # its in-flight work: not overload, just "not here" —
+                    # any untried replica can take it
+                    err = e
+                elif kind == "overloaded":
+                    return await self._dispatch_shed_alternate(
+                        model, version, wid, reqs, keys, sharded, e)
+                else:
+                    raise
+            if attempt >= self.config.max_dispatch_retries:
+                raise err
             if (model in self._disagg
-                    and getattr(e, "kind", "") == DECODE_PEER_UNREACHABLE):
-                logger.warning("decode peer behind %s down (%s) — retrying "
-                               "on alternate decode shard", worker_id, e)
-                alt = self._pick_alternate(model, version, worker_id,
-                                           keys[0], sharded)
+                    and isinstance(err, TRANSPORT_ERRORS)):
+                # disaggregated: the failure was the (stateless) prefill
+                # worker, already marked; re-dispatch re-picks a prefill
+                # from the healthy remainder — decode target unchanged
+                alt = wid
+            else:
+                alt = self._pick_alternate(model, version, wid, keys[0],
+                                           sharded, exclude=tried)
                 if alt is None:
-                    raise
-                return await self._dispatch_once(model, alt, reqs)
-            if getattr(e, "kind", "") == "overloaded":
-                # batch-path sheds normally arrive as per-request results
-                # (run_group handles those); a whole-call overloaded error
-                # reaches here only from the streaming handler's typed
-                # raise relayed through a batch call — defense in depth:
-                # one alternate, then surface. _overload_rejections counts
-                # FINAL client-visible sheds only (same meaning as
-                # run_group's per-request count), so a successful
-                # alternate dispatch is not a rejection
-                alt = self._pick_alternate(model, version, worker_id,
-                                           keys[0], sharded)
-                if alt is None:
-                    self._overload_rejections += 1
-                    raise
-                logger.info("worker %s overloaded — trying alternate %s",
-                            worker_id, alt)
-                try:
-                    return await self._dispatch_once(model, alt, reqs)
-                except WorkerRPCError as e2:
-                    if getattr(e2, "kind", "") != "overloaded":
-                        raise
-                    # both replicas shed: count + typed error, same
-                    # contract as the streaming path
-                    self._overload_rejections += 1
-                    from ..engine.types import EngineOverloadedError
+                    raise err
+                tried.add(alt)
+            attempt += 1
+            self._dispatch_retries += 1
+            delay = self._retry_backoff_s(attempt - 1)
+            logger.warning(
+                "dispatch to %s failed (%s: %s) — retry %d/%d on %s in "
+                "%.0fms", wid, type(err).__name__, err, attempt,
+                self.config.max_dispatch_retries, alt, delay * 1e3)
+            if delay:
+                await asyncio.sleep(delay)
+            wid = alt
 
-                    raise EngineOverloadedError(
-                        "request shed by every tried replica; back off "
-                        "and retry", reason=_shed_reason(e2)) from e2
-            raise
+    async def _dispatch_shed_alternate(
+        self, model: str, version: str, worker_id: str,
+        reqs: List, keys: List[str], sharded: bool, exc: Exception,
+    ) -> List[Dict[str, Any]]:
+        """Whole-call ``queue_full`` shed: one alternate, then surface.
+        Batch-path sheds normally arrive as per-request results (run_group
+        handles those); a whole-call overloaded error reaches here only
+        from the streaming handler's typed raise relayed through a batch
+        call — defense in depth. ``_overload_rejections`` counts FINAL
+        client-visible sheds only (same meaning as run_group's per-request
+        count), so a successful alternate dispatch is not a rejection."""
+        alt = self._pick_alternate(model, version, worker_id,
+                                   keys[0], sharded)
+        if alt is None:
+            self._overload_rejections += 1
+            raise exc
+        logger.info("worker %s overloaded — trying alternate %s",
+                    worker_id, alt)
+        try:
+            return await self._dispatch_once(model, alt, reqs)
+        except WorkerRPCError as e2:
+            if getattr(e2, "kind", "") != "overloaded":
+                raise
+            # both replicas shed: count + typed error, same contract as
+            # the streaming path
+            self._overload_rejections += 1
+            raise EngineOverloadedError(
+                "request shed by every tried replica; back off "
+                "and retry", reason=shed_reason(e2)) from e2
 
     def _pick_alternate(self, model: str, version: str, failed: str,
-                        key: str, sharded: bool) -> Optional[str]:
+                        key: str, sharded: bool,
+                        exclude: Optional[set] = None) -> Optional[str]:
+        """An untried replacement for ``failed``. ``exclude`` carries every
+        worker the retry budget has already tried (the failed one is always
+        excluded) so a multi-attempt retry walks the fleet instead of
+        ping-ponging between two hosts."""
+        excluded = set(exclude) if exclude else set()
+        excluded.add(failed)
         if sharded:
             if not self.config.health.enable_failover:
                 return None
-            # exclude the WORKER, not just one shard — the failed host may
+            # exclude the WORKERS, not just one shard — a failed host may
             # hold several shards and the deterministic backup must not land
             # on any of them
             alt = self.router._find_alternative_shard(
-                model, version, key, exclude=-1, exclude_worker=failed,
+                model, version, key, exclude=-1, exclude_worker=excluded,
             )
             return alt.worker_id if alt else None
         candidates = [s for s in self.lb.healthy_workers()
-                      if s.worker_id != failed]
+                      if s.worker_id not in excluded]
         if not candidates:
             return None
         return min(candidates, key=lambda s: s.active_connections).worker_id
@@ -920,6 +1139,7 @@ class Coordinator:
             # already landed
             try:
                 self.cache.save(self.config.cache.persist_path)
+            # graftlint: ok[swallowed-transport-error] local persistence, no peer involved; the control-plane snapshot already landed
             except Exception:
                 logger.exception("cache snapshot to %s failed — control-"
                                  "plane state was saved",
@@ -976,7 +1196,7 @@ class Coordinator:
             # best-effort per model: application errors (RPCError — e.g. a
             # worker that kept a mismatched engine) AND transport errors
             # are logged, never fatal to the rest of the restore
-            recoverable = (*_TRANSPORT_ERRORS, WorkerRPCError)
+            recoverable = (*TRANSPORT_ERRORS, WorkerRPCError)
             for name, cfg in self._model_configs.items():
                 pool = self._disagg.get(name)
                 try:
@@ -1068,6 +1288,7 @@ class Coordinator:
                               else self.lb.client_for(wid))
                     return wid, await client.call("metrics",
                                                   timeout=timeout_s)
+                # graftlint: ok[swallowed-transport-error] best-effort scrape probe — an unreachable worker shows up as absent families; the health loops own the marking
                 except Exception:
                     return wid, None
 
@@ -1083,6 +1304,10 @@ class Coordinator:
             "submitted": self._submitted,
             "cache_hits": self._cache_hits,
             "overload_rejections": self._overload_rejections,
+            "dispatch_retries": self._dispatch_retries,
+            "stream_resumes": self._stream_resumes,
+            "deadline_expired": self._deadline_expired,
+            "drains": self._drains,
             "cache": self.cache.get_stats(),
             "batcher": self.batcher.get_stats(),
             "router": self.router.get_stats(),

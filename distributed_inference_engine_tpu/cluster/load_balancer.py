@@ -48,6 +48,15 @@ class LoadBalancerStrategy(str, enum.Enum):
     LEAST_LATENCY = "least_latency"
 
 
+# per-worker circuit breaker states (docs/design.md "Failure model"):
+# CLOSED = normal traffic; OPEN = excluded from selection, cooling down;
+# HALF_OPEN = cooldown over, exactly one trial probe outstanding.
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half_open"
+BREAKER_OPEN = "open"
+_BREAKER_CODE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+
 @dataclass
 class WorkerStats:
     """Reference ``src/load_balancer.py:25-37`` — with probe stats separated."""
@@ -63,6 +72,9 @@ class WorkerStats:
     last_probe: float = 0.0
     probe_count: int = 0
     probe_failures: int = 0
+    breaker_state: str = BREAKER_CLOSED
+    breaker_opened_at: float = 0.0
+    breaker_opens: int = 0
     metadata: Dict[str, Any] = field(default_factory=dict)
 
     @property
@@ -142,6 +154,11 @@ class LoadBalancer:
         stats = self.workers.pop(worker_id, None)
         client = self._clients.pop(worker_id, None)
         if client is not None:
+            # tear in-flight calls NOW: their pending reads fail fast as
+            # transport errors and the coordinator's retry budget requeues
+            # the work, instead of queued dispatches timing out against a
+            # deregistered target
+            client.abort_inflight()
             try:
                 task = asyncio.get_running_loop().create_task(client.close())
                 self._bg_tasks.add(task)
@@ -163,7 +180,44 @@ class LoadBalancer:
     # -- selection (reference src/load_balancer.py:128-164) -------------------
 
     def _is_healthy(self, s: WorkerStats) -> bool:
-        return s.consecutive_failures < self.health_config.max_consecutive_failures
+        return (s.breaker_state == BREAKER_CLOSED
+                and s.consecutive_failures
+                < self.health_config.max_consecutive_failures)
+
+    # -- circuit breaker ------------------------------------------------------
+
+    def _record_failure(self, s: WorkerStats) -> None:
+        s.consecutive_failures += 1
+        if s.breaker_state == BREAKER_HALF_OPEN:
+            # the one trial probe failed: re-open and restart the cooldown
+            self._open_breaker(s)
+        elif (s.breaker_state == BREAKER_CLOSED
+              and s.consecutive_failures
+              >= self.health_config.max_consecutive_failures):
+            self._open_breaker(s)
+
+    def _record_success(self, s: WorkerStats) -> None:
+        s.consecutive_failures = 0
+        if s.breaker_state != BREAKER_CLOSED:
+            logger.info("lb: circuit for %s closed", s.worker_id)
+        s.breaker_state = BREAKER_CLOSED
+
+    def _open_breaker(self, s: WorkerStats) -> None:
+        s.breaker_state = BREAKER_OPEN
+        s.breaker_opened_at = time.monotonic()
+        s.breaker_opens += 1
+        logger.info("lb: circuit for %s opened (%d consecutive failures)",
+                    s.worker_id, s.consecutive_failures)
+
+    def quarantine(self, worker_id: str) -> bool:
+        """Administratively open a worker's circuit (the drain/remove path):
+        it drops out of selection immediately; a successful half-open probe
+        or real-traffic success re-admits it."""
+        s = self.workers.get(worker_id)
+        if s is None:
+            return False
+        self._open_breaker(s)
+        return True
 
     def healthy_workers(self) -> List[WorkerStats]:
         return [s for s in self.workers.values() if self._is_healthy(s)]
@@ -216,10 +270,10 @@ class LoadBalancer:
         s.request_count += 1
         s.total_latency_s += latency_s
         if success:
-            s.consecutive_failures = 0     # reference :187-191
+            self._record_success(s)        # reference :187-191
         else:
             s.error_count += 1
-            s.consecutive_failures += 1
+            self._record_failure(s)
 
     # -- health loop (reference src/load_balancer.py:293-348) -----------------
 
@@ -227,6 +281,7 @@ class LoadBalancer:
         while self._running:
             try:
                 await self.check_all_workers()
+            # graftlint: ok[swallowed-transport-error] per-worker failures are marked inside check_worker; this guards the sweep loop itself from dying
             except Exception:
                 logger.exception("lb: health sweep failed")
             await asyncio.sleep(self.health_config.check_interval)
@@ -238,22 +293,40 @@ class LoadBalancer:
 
     async def check_worker(self, worker_id: str) -> bool:
         """Ping probe. Touches only health/probe fields — never the request
-        stats the LEAST_LATENCY strategy reads (fixed reference pitfall)."""
+        stats the LEAST_LATENCY strategy reads (fixed reference pitfall).
+
+        Breaker-aware: an OPEN circuit is probed only after its cooldown
+        (half-open, one trial) — no hammering a host that just failed N
+        times in a row. A ping that reports ``draining: true`` counts as a
+        failed probe: the worker is alive but refusing admission, so it
+        must stay out of rotation until the drain finishes."""
         s = self.workers.get(worker_id)
         if s is None:
             return False
         s.last_probe = time.monotonic()
+        if s.breaker_state == BREAKER_OPEN:
+            cooled = (time.monotonic() - s.breaker_opened_at
+                      >= self.health_config.breaker_cooldown_s)
+            if not cooled:
+                return False
+            s.breaker_state = BREAKER_HALF_OPEN
         s.probe_count += 1
         try:
-            await self.client_for(worker_id).ping(
+            pong = await self.client_for(worker_id).ping(
                 timeout=self.health_config.check_timeout
             )
         except Exception as e:
             logger.debug("lb: probe of %s failed: %s", worker_id, e)
             s.probe_failures += 1
-            s.consecutive_failures += 1
+            self._record_failure(s)
             return False
-        s.consecutive_failures = 0
+        if isinstance(pong, dict) and pong.get("draining"):
+            logger.debug("lb: %s is draining — held out of rotation",
+                         worker_id)
+            s.probe_failures += 1
+            self._record_failure(s)
+            return False
+        self._record_success(s)
         return True
 
     # -- introspection (reference src/load_balancer.py:193-226) ---------------
@@ -273,6 +346,9 @@ class LoadBalancer:
             "consecutive_failures": s.consecutive_failures,
             "probe_count": s.probe_count,
             "probe_failures": s.probe_failures,
+            "breaker_state": s.breaker_state,
+            "breaker_state_code": _BREAKER_CODE[s.breaker_state],
+            "breaker_opens": s.breaker_opens,
         }
 
     def get_all_stats(self) -> Dict[str, Any]:

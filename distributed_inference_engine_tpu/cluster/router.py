@@ -136,6 +136,10 @@ class Router:
         info = self.workers.pop(worker_id, None)
         client = self._clients.pop(worker_id, None)
         if client is not None:
+            # tear in-flight calls NOW so they fail fast as transport
+            # errors (requeued by the coordinator's retry budget) instead
+            # of timing out against a deregistered target
+            client.abort_inflight()
             # best-effort close; caller may not be in a loop
             try:
                 loop = asyncio.get_running_loop()
@@ -197,18 +201,26 @@ class Router:
 
     def _find_alternative_shard(
         self, model: str, version: str, key: str, exclude: int,
-        exclude_worker: Optional[str] = None,
+        exclude_worker=None,
     ) -> Optional[ModelShard]:
         """Deterministic backup: hash(key) mod healthy-shard-count
-        (reference ``src/router.py:186-221``) — stable per key, so failover
-        keeps prefix-cache affinity too. ``exclude_worker`` drops every shard
-        hosted by that worker (transport-failure retry must not land on
-        another shard of the same dead host)."""
+        (reference ``src/router.py:186-221``) — stable per key GIVEN the
+        same healthy set, so failover keeps prefix-cache affinity too.
+        ``exclude_worker`` (one id or a collection of ids) drops every
+        shard hosted by those workers — a transport-failure retry must not
+        land on another shard of the same dead host, and the retry budget
+        accumulates already-tried workers here."""
+        if exclude_worker is None:
+            excluded = ()
+        elif isinstance(exclude_worker, str):
+            excluded = (exclude_worker,)
+        else:
+            excluded = tuple(exclude_worker)
         healthy: List[ModelShard] = []
         for shard in self.registry.all_shards(model, version):
             if shard.shard_id == exclude:
                 continue
-            if exclude_worker is not None and shard.worker_id == exclude_worker:
+            if shard.worker_id in excluded:
                 continue
             w = self.workers.get(shard.worker_id)
             if w is not None and w.health is not WorkerHealth.UNHEALTHY:
@@ -245,6 +257,7 @@ class Router:
         while self._running:
             try:
                 await self.check_all_workers()
+            # graftlint: ok[swallowed-transport-error] per-worker failures are marked inside check_worker; this guards the sweep loop itself from dying
             except Exception:
                 logger.exception("router: health sweep failed")
             await asyncio.sleep(self.health_config.check_interval)
@@ -261,11 +274,15 @@ class Router:
             return False
         info.last_check = time.monotonic()
         try:
-            await self.client_for(worker_id).ping(
+            pong = await self.client_for(worker_id).ping(
                 timeout=self.health_config.check_timeout
             )
         except Exception as e:
             logger.debug("router: probe of %s failed: %s", worker_id, e)
+            self.mark_worker_failure(worker_id)
+            return False
+        if isinstance(pong, dict) and pong.get("draining"):
+            # alive but refusing admission — keep it out of rotation
             self.mark_worker_failure(worker_id)
             return False
         self.mark_worker_success(worker_id)

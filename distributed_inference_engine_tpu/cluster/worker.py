@@ -62,6 +62,17 @@ class DecodePeerError(RuntimeError):
     rpc_error_kind = DECODE_PEER_UNREACHABLE
 
 
+class WorkerDrainingError(RuntimeError):
+    """Admission refused: this worker is draining (finishing in-flight work
+    before removal). Wire kind is ``overloaded`` with detail ``draining`` so
+    the coordinator's existing shed machinery retries on an alternate replica
+    — and, because sheds bypass health accounting, the drain doesn't dent
+    this worker's health while it finishes."""
+
+    rpc_error_kind = "overloaded"
+    rpc_error_detail = "draining"
+
+
 # --------------------------------------------------------------------------
 # request/result wire marshalling (token-id space; tokenization is a client/
 # coordinator concern)
@@ -78,6 +89,7 @@ def request_to_dict(r: GenerationRequest) -> Dict[str, Any]:
         "eos_id": r.eos_id,
         "stop_ids": list(r.stop_ids),
         "stop_sequences": [list(s) for s in r.stop_sequences],
+        "deadline_s": r.deadline_s,
     }
 
 
@@ -94,6 +106,8 @@ def request_from_dict(d: Dict[str, Any]) -> GenerationRequest:
         stop_ids=[int(t) for t in d.get("stop_ids", [])],
         stop_sequences=[[int(t) for t in s]
                         for s in d.get("stop_sequences", [])],
+        deadline_s=(float(d["deadline_s"])
+                    if d.get("deadline_s") is not None else None),
     )
 
 
@@ -198,6 +212,12 @@ class WorkerServer(FramedServerMixin):
                                          # total_handoff_bytes)
         self._ping_count = 0
         self._active_connections = 0
+        # graceful drain: when set, admission verbs refuse new work (typed
+        # as a "draining" shed) while in-flight requests run to completion
+        self._draining = False
+        self._busy = 0                 # admission RPCs currently executing
+        self._drain_count = 0
+        self._deadline_expired_count = 0
         self.latency = LatencyStats()
         self._methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]] = {
             "ping": self._rpc_ping,
@@ -212,6 +232,7 @@ class WorkerServer(FramedServerMixin):
             "metrics": self._rpc_metrics,
             "metrics_text": self._rpc_metrics_text,
             "profile": self._rpc_profile,
+            "drain": self._rpc_drain,
             "shutdown": self._rpc_shutdown,
         }
         # unified telemetry: this worker's dict metrics (incl. every loaded
@@ -363,8 +384,9 @@ class WorkerServer(FramedServerMixin):
         # generate/load_model legitimately run for minutes (first-call XLA
         # compile, checkpoint load) — their deadline belongs to the caller.
         # The server-side timeout only guards the cheap control methods.
+        # drain carries its own timeout_s in the message.
         if method in ("generate", "load_model", "prefill",
-                      "generate_prefilled", "prefill_generate"):
+                      "generate_prefilled", "prefill_generate", "drain"):
             return await handler(msg)
         return await asyncio.wait_for(
             handler(msg), timeout=self.config.request_timeout
@@ -383,8 +405,14 @@ class WorkerServer(FramedServerMixin):
             # load sheds are the engine WORKING as configured, not a fault:
             # counting them would let sustained overload trip the same
             # error-rate signals a sick worker trips
-            if getattr(exc, "rpc_error_kind", "") == "overloaded":
+            kind = getattr(exc, "rpc_error_kind", "")
+            if kind == "overloaded":
                 self._overloaded_count += 1
+                return
+            if kind == "deadline":
+                # caller-imposed budget expired in OUR queue — policy, not
+                # a fault; it has its own counter so dashboards can see it
+                self._deadline_expired_count += 1
                 return
             self._error_count += 1
 
@@ -401,7 +429,16 @@ class WorkerServer(FramedServerMixin):
     async def _rpc_ping(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._ping_count += 1
         return {"worker_id": self.worker_id, "time": time.time(),
-                "models": sorted(self.engines)}
+                "models": sorted(self.engines),
+                "draining": self._draining}
+
+    def _admit(self) -> None:
+        """Admission gate for work-carrying verbs (generate/prefill family):
+        a draining worker refuses new work with the typed draining shed."""
+        if self._draining:
+            raise WorkerDrainingError(
+                f"worker {self.worker_id} is draining — retry on another "
+                "replica")
 
     def _attach_worker_trace(self, result: GenerationResult,
                              t_recv: float) -> None:
@@ -424,27 +461,34 @@ class WorkerServer(FramedServerMixin):
 
     async def _rpc_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         t_recv = time.perf_counter()
+        self._admit()
         name, engine = self._engine_for(msg, "generate")
         reqs = [request_from_dict(d) for d in msg.get("requests", [])]
         if not reqs:
             raise ValueError("empty 'requests'")
         self._request_count += 1
-        pump = self._pumps.get(name)
-        if pump is not None:
-            # continuous engine: requests join the rolling decode batch —
-            # concurrent connections share chunks instead of serializing
-            # whole generations behind the executor
-            results = await pump.generate(reqs)
-        else:
-            loop = asyncio.get_running_loop()
-            results = await loop.run_in_executor(
-                self._executor, engine.generate, reqs
-            )
+        self._busy += 1
+        try:
+            pump = self._pumps.get(name)
+            if pump is not None:
+                # continuous engine: requests join the rolling decode batch —
+                # concurrent connections share chunks instead of serializing
+                # whole generations behind the executor
+                results = await pump.generate(reqs)
+            else:
+                loop = asyncio.get_running_loop()
+                results = await loop.run_in_executor(
+                    self._executor, engine.generate, reqs
+                )
+        finally:
+            self._busy -= 1
         # sheds are per-request RESULTS (finish_reason "overloaded"), so
         # they bypass _on_handler_error — count them here, still apart
         # from real errors
         self._overloaded_count += sum(
             1 for r in results if r.finish_reason == "overloaded")
+        self._deadline_expired_count += sum(
+            1 for r in results if r.finish_reason == "deadline")
         for r in results:
             self._attach_worker_trace(r, t_recv)
         return {"model": name, "results": [result_to_dict(r) for r in results]}
@@ -457,6 +501,7 @@ class WorkerServer(FramedServerMixin):
         result envelope. Continuous engines only (the rolling batch emits
         per-chunk; a static engine runs to completion in one call — use
         ``generate`` there)."""
+        self._admit()
         name, _engine = self._engine_for(msg, "generate")
         pump = self._pumps.get(name)
         if pump is None:
@@ -466,10 +511,14 @@ class WorkerServer(FramedServerMixin):
         req = request_from_dict(msg.get("request") or {})
         t_recv = time.perf_counter()
         self._request_count += 1
-        queue: asyncio.Queue = asyncio.Queue()
-        fut = asyncio.ensure_future(
-            pump.generate_streaming(req, queue.put_nowait))
-        result = await relay_stream(fut, queue, send)
+        self._busy += 1
+        try:
+            queue: asyncio.Queue = asyncio.Queue()
+            fut = asyncio.ensure_future(
+                pump.generate_streaming(req, queue.put_nowait))
+            result = await relay_stream(fut, queue, send)
+        finally:
+            self._busy -= 1
         self._attach_worker_trace(result, t_recv)
         return {"model": name, "result": result_to_dict(result)}
 
@@ -543,15 +592,20 @@ class WorkerServer(FramedServerMixin):
         """Prefill-pool op: run the prompt, return KV handoffs to the caller."""
         from ..engine.disagg import handoff_to_wire
 
+        self._admit()
         name, engine = self._engine_for(msg, "prefill")
         reqs = [request_from_dict(d) for d in msg.get("requests", [])]
         if not reqs:
             raise ValueError("empty 'requests'")
         self._request_count += 1
-        loop = asyncio.get_running_loop()
-        handoffs = await loop.run_in_executor(
-            self._executor, engine.prefill, reqs
-        )
+        self._busy += 1
+        try:
+            loop = asyncio.get_running_loop()
+            handoffs = await loop.run_in_executor(
+                self._executor, engine.prefill, reqs
+            )
+        finally:
+            self._busy -= 1
         return {"model": name,
                 "handoffs": [handoff_to_wire(h) for h in handoffs]}
 
@@ -597,6 +651,7 @@ class WorkerServer(FramedServerMixin):
         """Decode-pool op: admit handed-off KV, decode to completion."""
         from ..engine.disagg import handoff_from_wire
 
+        self._admit()
         name, _engine = self._engine_for(msg, "submit_prefilled")
         pump = self._pumps.get(name)
         if pump is None:
@@ -609,7 +664,11 @@ class WorkerServer(FramedServerMixin):
         if len(reqs) != len(handoffs) or not reqs:
             raise ValueError("requests and handoffs must align and be non-empty")
         self._request_count += 1
-        results = await pump.generate_prefilled(list(zip(reqs, handoffs)))
+        self._busy += 1
+        try:
+            results = await pump.generate_prefilled(list(zip(reqs, handoffs)))
+        finally:
+            self._busy -= 1
         return {"model": name, "results": [result_to_dict(r) for r in results]}
 
     async def _rpc_prefill_generate(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -633,6 +692,7 @@ class WorkerServer(FramedServerMixin):
         """
         from ..engine.disagg import handoff_to_wire
 
+        self._admit()
         name, engine = self._engine_for(msg, "prefill")
         host, port = msg.get("decode_host"), msg.get("decode_port")
         if not host or not port:
@@ -802,6 +862,7 @@ class WorkerServer(FramedServerMixin):
                 out[j] = retry["results"][0]
             return out
 
+        self._busy += 1
         tasks = [asyncio.ensure_future(run_group(g)) for g in groups]
         try:
             group_outs = await asyncio.gather(*tasks)
@@ -820,6 +881,8 @@ class WorkerServer(FramedServerMixin):
                     f"{type(e).__name__}: {e}"
                 ) from e
             raise
+        finally:
+            self._busy -= 1
         results: List[Any] = [None] * len(reqs_wire)
         for g_idxs, outs in zip(groups, group_outs):
             for i, r in zip(g_idxs, outs):
@@ -859,6 +922,42 @@ class WorkerServer(FramedServerMixin):
                     self.metrics_text().encode("utf-8"))
         return None
 
+    async def _rpc_drain(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Graceful drain: stop admitting (new work gets the typed
+        ``draining`` shed, probes see ``draining`` in ping), wait for
+        in-flight work — pumps' inboxes/futures and the ``_busy`` admission
+        counter — to empty, then report a per-model summary so the caller
+        can account for what this worker was holding (KV/prefix/token
+        counters) before removing it. Idempotent; ``timeout_s`` rides in
+        the message (this verb is exempt from the server-side timeout)."""
+        timeout_s = float(msg.get("timeout_s", 30.0))
+        if not self._draining:
+            self._draining = True
+            self._drain_count += 1
+            logger.info("worker %s draining (timeout %.1fs)",
+                        self.worker_id, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        drained = True
+        for pump in self._pumps.values():
+            remaining = max(0.0, deadline - time.monotonic())
+            if not await pump.drain(remaining):
+                drained = False
+        while self._busy > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        if self._busy > 0:
+            drained = False
+        summary: Dict[str, Any] = {}
+        for name, engine in self.engines.items():
+            m = engine.get_metrics()
+            summary[name] = {
+                k: v for k, v in m.items()
+                if isinstance(v, (int, float)) and any(
+                    t in k for t in ("prefix", "kv", "page", "token",
+                                     "request", "waiting", "live"))
+            }
+        return {"worker_id": self.worker_id, "drained": drained,
+                "in_flight": self._busy, "models": summary}
+
     async def _rpc_shutdown(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._shutdown_event.set()
         return {"shutting_down": True}
@@ -876,7 +975,8 @@ class WorkerServer(FramedServerMixin):
                 "cpu_percent": p.cpu_percent(interval=None),
                 "num_threads": p.num_threads(),
             }
-        except Exception:  # psutil optional, like the undeclared reference dep
+        # graftlint: ok[swallowed-transport-error] psutil is optional (undeclared reference dep); process introspection, no peer involved
+        except Exception:
             pass
         return {
             "worker_id": self.worker_id,
@@ -884,6 +984,12 @@ class WorkerServer(FramedServerMixin):
             "request_count": self._request_count,
             "error_count": self._error_count,
             "overloaded_count": self._overloaded_count,
+            "deadline_expired_count": self._deadline_expired_count,
+            "draining": 1 if self._draining else 0,
+            "drain_count": self._drain_count,
+            "injected_faults": (
+                self.fault_plan.injected_count(self._fault_scope())
+                if self.fault_plan is not None else 0),
             "handoff_bytes_shipped": self._handoff_bytes_shipped,
             "ping_count": self._ping_count,          # probes counted apart
             "active_connections": self._active_connections,
@@ -1004,6 +1110,13 @@ class WorkerClient(FramedRPCClient):
     async def unload_model(self, name: str) -> bool:
         result = await self.call("unload_model", model=name)
         return bool(result["unloaded"])
+
+    async def drain(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Gracefully drain the worker: stop admission, wait for in-flight
+        work, return its per-model summary. The RPC read allowance adds
+        headroom over the worker-side wait."""
+        return await self.call("drain", timeout_s=timeout_s,
+                               timeout=timeout_s + 10.0)
 
     async def metrics(self) -> Dict[str, Any]:
         return await self.call("metrics")

@@ -292,12 +292,20 @@ class CacheConfig:
 @dataclass
 class HealthConfig:
     """Reference ``src/router.py:57-79`` / ``src/load_balancer.py:42-60``:
-    probe cadence + N-consecutive-failures threshold."""
+    probe cadence + N-consecutive-failures threshold, extended with the
+    per-worker circuit breaker the LB health loop drives (docs/design.md
+    "Failure model")."""
 
     check_interval: float = 5.0
     check_timeout: float = 2.0
     max_consecutive_failures: int = 3
     enable_failover: bool = True
+    # circuit breaker: after max_consecutive_failures the worker's circuit
+    # OPENS (excluded from selection). The health loop waits out the
+    # cooldown, then sends ONE half-open probe: success closes the
+    # circuit, failure re-opens it and restarts the cooldown. 0.0 means
+    # probe at the next health-loop tick (no extra wait).
+    breaker_cooldown_s: float = 0.0
 
 
 @dataclass

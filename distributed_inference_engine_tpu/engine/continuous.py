@@ -758,6 +758,7 @@ class ContinuousEngine:
         self._admission_denied = 0
         self._rejected_full = 0        # submits refused: queue at cap
         self._shed_deadline = 0        # queued requests shed past deadline
+        self._deadline_expired = 0     # per-request deadline_s expiries
         self._capacity_finishes = 0
         self._swap_outs = 0         # decode victims parked on the host tier
         self._swap_resumes = 0      # parked victims back in a slot (no prefill)
@@ -871,37 +872,61 @@ class ContinuousEngine:
                 "retry on another replica or later", reason="queue_full")
 
     def _shed_expired(self) -> None:
-        """Deadline-based shedding: a request still queued after
-        ``queue_deadline_s`` resolves with ``finish_reason="overloaded"``
-        (zero tokens, ttft = its queue wait) instead of prefilling work the
-        client has likely already timed out on. The pump converts the
-        outcome into the typed ``EngineOverloadedError`` for RPC clients."""
-        deadline = self.config.queue_deadline_s
-        if not deadline:
-            return
-        cut = time.perf_counter() - deadline
+        """Deadline-based shedding, two budgets checked at step start —
+        before any prefill/decode work is spent on the victim:
+
+        - the engine-wide ``queue_deadline_s`` (overload control): a
+          request still queued past it resolves with
+          ``finish_reason="overloaded"`` (reason "deadline", zero tokens,
+          ttft = its queue wait) — the pump converts the outcome into the
+          typed ``EngineOverloadedError`` for RPC clients;
+        - the request's OWN ``deadline_s`` budget (the client deadline the
+          coordinator propagates in RPC metadata): expiry resolves with
+          ``finish_reason="deadline"`` and is never retried upstream —
+          the client already stopped caring.
+        """
+        queue_deadline = self.config.queue_deadline_s
+        now = time.perf_counter()
+        cut = (now - queue_deadline) if queue_deadline else None
         for q, t_idx in ((self._waiting, 2), (self._waiting_prefilled, 3)):
-            if not q or q[0][t_idx] > cut:
-                # FIFO queues: the head is the oldest — nothing expired
+            if not q:
+                continue
+            # FIFO queues: the head is the oldest, so the global budget is
+            # an O(1) head check; per-request deadlines need the scan, but
+            # only when some queued request actually carries one.
+            if not (cut is not None and q[0][t_idx] <= cut) and not any(
+                    item[0].deadline_s is not None for item in q):
                 continue
             keep = type(q)()
             for item in q:
-                if item[t_idx] <= cut:
-                    req = item[0]
+                req, t = item[0], item[t_idx]
+                if cut is not None and t <= cut:
                     self._shed_deadline += 1
                     self._finished.append(GenerationResult(
                         request_id=req.request_id,
                         tokens=[],
                         finish_reason="overloaded",
                         prompt_tokens=len(req.prompt),
-                        ttft_s=time.perf_counter() - item[t_idx],
+                        ttft_s=now - t,
                         decode_s=0.0,
                         metadata={"overload_reason": "deadline"},
                     ))
+                elif req.deadline_s is not None and now - t >= req.deadline_s:
+                    self._deadline_expired += 1
+                    self._finished.append(GenerationResult(
+                        request_id=req.request_id,
+                        tokens=[],
+                        finish_reason="deadline",
+                        prompt_tokens=len(req.prompt),
+                        ttft_s=now - t,
+                        decode_s=0.0,
+                        metadata={"deadline_s": req.deadline_s},
+                    ))
                 else:
                     keep.append(item)
-            q.clear()
-            q.extend(keep)
+            if len(keep) != len(q):
+                q.clear()
+                q.extend(keep)
 
     # ---------------------------------------------------------- admission
 
@@ -2302,6 +2327,7 @@ class ContinuousEngine:
             "admission_denied": self._admission_denied,
             "rejected_queue_full": self._rejected_full,
             "shed_deadline": self._shed_deadline,
+            "deadline_expired": self._deadline_expired,
             "capacity_finishes": self._capacity_finishes,
             "engine_steps": self._steps,
             "prefill_calls": self._prefill_calls,

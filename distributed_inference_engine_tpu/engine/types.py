@@ -8,7 +8,7 @@ the device stack. ``engine.engine`` re-exports both names.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 # machine-readable error class for load shedding: the engine's waiting
@@ -28,10 +28,30 @@ class EngineOverloadedError(RuntimeError):
 
     def __init__(self, msg: str, reason: str = "queue_full") -> None:
         super().__init__(msg)
-        self.reason = reason            # "queue_full" | "deadline"
+        self.reason = reason    # "queue_full" | "deadline" | "draining"
         # rides the RPC error envelope as ``error_detail`` so remote
         # callers get the reason structurally, not by sniffing text
         self.rpc_error_detail = reason
+
+
+# machine-readable error class for a request that aged out of its OWN
+# per-request budget (``GenerationRequest.deadline_s``). Distinct from an
+# OVERLOADED shed: a shed is the worker's problem (retriable elsewhere),
+# a deadline expiry is the request's problem (never retried — the client
+# already stopped caring, and replaying it only wastes another worker's
+# engine steps).
+DEADLINE = "deadline"
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's per-request deadline expired before completion."""
+
+    rpc_error_kind = DEADLINE
+
+    def __init__(self, msg: str, request_id: str = "") -> None:
+        super().__init__(msg)
+        self.request_id = request_id
+        self.rpc_error_detail = request_id
 
 
 @dataclass
@@ -52,6 +72,12 @@ class GenerationRequest:
     # token/sequence is INCLUDED in the output (same contract as eos_id).
     stop_ids: List[int] = field(default_factory=list)
     stop_sequences: List[List[int]] = field(default_factory=list)
+    # remaining per-request time budget in seconds, measured from engine
+    # submit. None = no deadline. The coordinator decrements it by queue/
+    # transit time before each dispatch hop, so the value a worker sees is
+    # the budget it actually has left; engines shed the request unstarted
+    # (finish_reason="deadline", zero decode steps) once it ages out.
+    deadline_s: Optional[float] = None
 
 
 def find_stop_cut(tokens: List[int], req: "GenerationRequest",

@@ -13,7 +13,7 @@ from .llama import llama_spec, mixtral_spec  # noqa: F401
 from .qwen import qwen_spec  # noqa: F401
 from .mistral import mistral_spec  # noqa: F401
 from .gemma import gemma_spec  # noqa: F401
-from .fake import FakeEngine  # noqa: F401
+from .fake import FakeContinuousEngine, FakeEngine  # noqa: F401
 
 # family prefix -> (spec factory, default size). Sizes live in each family
 # module; architecture strings like "qwen2-7b" select the size directly.
@@ -67,6 +67,18 @@ def engine_from_config(cfg):
 
     arch = cfg.architecture.lower()
     if arch == "fake":
+        if cfg.metadata.get("continuous"):
+            # continuous fake: submit/step interface, so the worker builds
+            # an EnginePump around it — streaming, deadlines, and drain
+            # become testable on a jax-free multi-worker fleet
+            return FakeContinuousEngine(
+                step_latency_s=float(cfg.metadata.get("step_latency_s", 0.0)),
+                tokens_per_step=int(cfg.metadata.get("tokens_per_step", 1)),
+                max_slots=int(cfg.metadata.get("max_slots", 8)),
+                max_waiting=int(cfg.metadata.get("max_waiting", 0)),
+                queue_deadline_s=float(
+                    cfg.metadata.get("queue_deadline_s", 0.0)),
+            )
         return FakeEngine(
             latency_s=float(cfg.metadata.get("latency_s", 0.0)),
             per_token_latency_s=float(cfg.metadata.get("per_token_latency_s", 0.0)),

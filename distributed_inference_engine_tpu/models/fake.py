@@ -17,9 +17,15 @@ from __future__ import annotations
 
 import random
 import time
+import zlib
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from ..engine.types import GenerationRequest, GenerationResult
+from ..engine.types import (
+    EngineOverloadedError,
+    GenerationRequest,
+    GenerationResult,
+)
 from ..utils.tracing import LatencyStats
 
 
@@ -80,4 +86,203 @@ class FakeEngine:
             "prefill": self.prefill_stats.snapshot(),
             "decode": self.decode_stats.snapshot(),
             "spec": {"fake": True},
+        }
+
+
+def _chain(state: int, token: int) -> int:
+    """Fold one token id into the crc32 context state."""
+    return zlib.crc32(b"%d," % token, state)
+
+
+@dataclass
+class FakeEngineConfig:
+    """The slice of ``EngineConfig`` the pump/worker plumbing touches."""
+
+    max_waiting: int = 0
+    queue_deadline_s: float = 0.0
+    mixed_step_tokens: int = 0      # pump compat knob; unused by the fake
+
+
+class FakeContinuousEngine:
+    """Continuous-batching fake: the submit/step/drain_finished interface
+    ``EnginePump`` drives, deterministic and jax-free.
+
+    The next token is a pure function of the FULL context (prompt +
+    tokens generated so far): a crc32 chain over the token ids, mod
+    ``vocab_size``. That makes output independent of which worker runs a
+    request AND resumable — replaying prompt+generated-prefix on another
+    replica continues with exactly the tokens the dead replica would
+    have produced next, which is what the chaos harness's token-for-token
+    stream-resume assertion checks.
+
+    Overload/deadline semantics mirror ``ContinuousEngine``: a bounded
+    waiting queue sheds at submit (``EngineOverloadedError``), the global
+    ``queue_deadline_s`` sheds queued requests as ``overloaded``/
+    ``deadline``, and a request's own ``deadline_s`` budget expires it
+    with ``finish_reason="deadline"`` before any decode step is spent.
+    Stop handling covers ``eos_id`` and ``stop_ids`` (no sequences — the
+    fleet tests don't use them).
+    """
+
+    def __init__(self, step_latency_s: float = 0.0, tokens_per_step: int = 1,
+                 max_slots: int = 8, max_waiting: int = 0,
+                 queue_deadline_s: float = 0.0, vocab_size: int = 997) -> None:
+        self.config = FakeEngineConfig(
+            max_waiting=int(max_waiting),
+            queue_deadline_s=float(queue_deadline_s))
+        self.step_latency_s = float(step_latency_s)
+        self.tokens_per_step = max(1, int(tokens_per_step))
+        self.max_slots = max(1, int(max_slots))
+        self.vocab_size = max(2, int(vocab_size))
+        # waiting: (request, on_tokens, t_submit); live: [req, cb, t_submit,
+        # chain state, tokens]
+        self._waiting: List[tuple] = []
+        self._live: List[list] = []
+        self._finished: List[GenerationResult] = []
+        self._total_requests = 0
+        self._total_generated = 0
+        self._steps = 0
+        self._rejected_full = 0
+        self._shed_deadline = 0
+        self._deadline_expired = 0
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, request: GenerationRequest, on_tokens=None) -> str:
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        cap = self.config.max_waiting
+        if cap and len(self._waiting) >= cap:
+            self._rejected_full += 1
+            raise EngineOverloadedError(
+                f"waiting queue full ({len(self._waiting)}/{cap}); retry "
+                "on another replica or later", reason="queue_full")
+        self._total_requests += 1
+        if not request.request_id:
+            request.request_id = f"fcreq-{self._total_requests}"
+        self._waiting.append((request, on_tokens, time.perf_counter()))
+        return request.request_id
+
+    # --------------------------------------------------------------- step
+
+    def _shed_expired(self) -> None:
+        queue_deadline = self.config.queue_deadline_s
+        now = time.perf_counter()
+        cut = (now - queue_deadline) if queue_deadline else None
+        keep = []
+        for req, cb, t in self._waiting:
+            if cut is not None and t <= cut:
+                self._shed_deadline += 1
+                self._finished.append(GenerationResult(
+                    request_id=req.request_id, tokens=[],
+                    finish_reason="overloaded", prompt_tokens=len(req.prompt),
+                    ttft_s=now - t,
+                    metadata={"overload_reason": "deadline"}))
+            elif req.deadline_s is not None and now - t >= req.deadline_s:
+                self._deadline_expired += 1
+                self._finished.append(GenerationResult(
+                    request_id=req.request_id, tokens=[],
+                    finish_reason="deadline", prompt_tokens=len(req.prompt),
+                    ttft_s=now - t, metadata={"deadline_s": req.deadline_s}))
+            else:
+                keep.append((req, cb, t))
+        self._waiting = keep
+
+    def step(self) -> int:
+        """One decode step for every live slot (admitting from the waiting
+        queue first); returns the live count, like ``ContinuousEngine``."""
+        self._shed_expired()
+        while self._waiting and len(self._live) < self.max_slots:
+            req, cb, t = self._waiting.pop(0)
+            state = 0
+            for tok in req.prompt:
+                state = _chain(state, tok)
+            self._live.append([req, cb, t, state, []])
+        if not self._live:
+            return 0
+        if self.step_latency_s:
+            time.sleep(self.step_latency_s)
+        self._steps += 1
+        now = time.perf_counter()
+        still: List[list] = []
+        for slot in self._live:
+            req, cb, t, state, toks = slot
+            fresh: List[int] = []
+            done = False
+            for _ in range(self.tokens_per_step):
+                nxt = state % self.vocab_size
+                state = _chain(state, nxt)
+                toks.append(nxt)
+                fresh.append(nxt)
+                self._total_generated += 1
+                if nxt == req.eos_id or nxt in (req.stop_ids or ()):
+                    done = True
+                    break
+                if len(toks) >= req.max_new_tokens:
+                    done = True
+                    break
+            slot[3] = state
+            if fresh and cb is not None:
+                cb(list(fresh))
+            if done:
+                stopped = bool(toks) and (
+                    toks[-1] == req.eos_id or toks[-1] in (req.stop_ids or ()))
+                self._finished.append(GenerationResult(
+                    request_id=req.request_id, tokens=list(toks),
+                    finish_reason="stop" if stopped else "length",
+                    prompt_tokens=len(req.prompt), ttft_s=now - t,
+                    decode_s=now - t, metadata={"fake": True}))
+            else:
+                still.append(slot)
+        self._live = still
+        return len(self._live)
+
+    def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
+        """Synchronous batch convenience (and the ``generate`` capability
+        marker the worker's ``_engine_for`` checks): submit, step to
+        completion, return in request order. Serving paths drive
+        submit/step through the pump instead."""
+        ids = [self.submit(r) for r in requests]
+        want = set(ids)
+        done: Dict[str, GenerationResult] = {}
+        while want - set(done):
+            self.step()
+            for res in self.drain_finished():
+                done[res.request_id] = res
+            if not self._live and not self._waiting and want - set(done):
+                for res in self.drain_finished():
+                    done[res.request_id] = res
+                break
+        return [done[i] for i in ids]
+
+    def drain_finished(self) -> List[GenerationResult]:
+        out, self._finished = self._finished, []
+        return out
+
+    def abort_all(self) -> int:
+        n = len(self._live) + len(self._waiting)
+        self._live.clear()
+        self._waiting.clear()
+        return n
+
+    @property
+    def n_waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._live)
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "total_requests": self._total_requests,
+            "total_prompt_tokens": 0,
+            "total_generated_tokens": self._total_generated,
+            "waiting": len(self._waiting),
+            "live_slots": len(self._live),
+            "engine_steps": self._steps,
+            "rejected_queue_full": self._rejected_full,
+            "shed_deadline": self._shed_deadline,
+            "deadline_expired": self._deadline_expired,
+            "spec": {"fake": True, "continuous": True},
         }

@@ -48,6 +48,8 @@ ENGINE_TABLE = [
      "Requests shed at submit: waiting queue full"),
     ("shed_deadline", "engine_shed_deadline", "c",
      "Requests shed after exceeding the queue deadline"),
+    ("deadline_expired", "engine_deadline_expired", "c",
+     "Requests expired in-queue by their own deadline_s budget"),
     ("capacity_finishes", "engine_capacity_finishes", "c",
      "Sequences force-finished (reason=length) by KV-pool exhaustion"),
     ("engine_steps", "engine_steps", "c",
@@ -232,6 +234,10 @@ LB_WORKER_TABLE = [                # get_all_stats()["workers"][wid]
     ("avg_latency_s", "lb_worker_avg_latency_seconds", "g",
      "Mean dispatch latency"),
     ("healthy", "lb_worker_healthy", "g", "1 if the LB considers it healthy"),
+    ("breaker_state_code", "lb_worker_breaker_state", "g",
+     "Circuit breaker state: 0 closed, 1 half-open, 2 open"),
+    ("breaker_opens", "lb_worker_breaker_opens", "c",
+     "Times this worker's circuit breaker opened"),
 ]
 
 REGISTRY_TABLE = [                 # ModelRegistry.get_stats()
@@ -248,6 +254,14 @@ COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
      "Submissions answered from the response cache"),
     ("overload_rejections", "coordinator_overload_rejections", "c",
      "Submissions shed by every tried replica"),
+    ("dispatch_retries", "coordinator_dispatch_retries", "c",
+     "Re-dispatches after transport failures or draining sheds"),
+    ("stream_resumes", "coordinator_stream_resumes", "c",
+     "Streams resumed on an alternate worker via prefix replay"),
+    ("deadline_expired", "coordinator_deadline_expired", "c",
+     "Requests answered with the typed deadline outcome"),
+    ("drains", "coordinator_drains", "c",
+     "Graceful worker drains completed"),
 ]
 
 WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
@@ -257,6 +271,13 @@ WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
     ("error_count", "worker_errors", "c", "RPC handler errors"),
     ("overloaded_count", "worker_overloaded", "c",
      "Requests shed by engine overload handling"),
+    ("deadline_expired_count", "worker_deadline_expired", "c",
+     "Requests whose deadline_s budget expired on this worker"),
+    ("draining", "worker_draining", "g",
+     "1 while the worker refuses admission (drain in progress)"),
+    ("drain_count", "worker_drains", "c", "Drain RPCs honored"),
+    ("injected_faults", "worker_injected_faults", "c",
+     "Chaos faults injected into this worker's server plane"),
     ("handoff_bytes_shipped", "worker_handoff_bytes_shipped", "c",
      "Disaggregated KV handoff bytes sent to decode peers"),
     ("ping_count", "worker_pings", "c", "Health probes answered"),

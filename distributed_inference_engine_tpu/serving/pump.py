@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..engine.types import (
+    DeadlineExceededError,
     EngineOverloadedError,
     GenerationRequest,
     GenerationResult,
@@ -121,6 +122,10 @@ class EnginePump:
             raise EngineOverloadedError(
                 f"request {res.request_id} shed ({reason}); retry on "
                 "another replica or later", reason=reason)
+        if res.finish_reason == "deadline":
+            raise DeadlineExceededError(
+                f"request {res.request_id} deadline expired while queued",
+                request_id=res.request_id)
         return res
 
     async def _submit_all(
@@ -142,6 +147,22 @@ class EnginePump:
         self._wake.set()
         results = await asyncio.gather(*futs)
         return list(results)
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until nothing is queued or in flight (the caller must have
+        stopped admission first — the worker's drain verb does). Returns
+        True if fully drained within the budget, False on timeout with
+        work still pending."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            with self._inbox_lock:
+                busy = bool(self._inbox)
+            busy = busy or bool(self._futures)
+            if not busy:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.02)
 
     async def stop(self) -> None:
         self.shutdown_nowait()
@@ -191,6 +212,7 @@ class EnginePump:
                 # then back off before serving fresh submissions
                 try:
                     self.engine.abort_all()
+                # graftlint: ok[swallowed-transport-error] engine-local best-effort abort during error recovery; no peer involved and the step error was already counted
                 except Exception:
                     logger.exception("engine abort_all failed")
                 # graftlint: ok[async-blocking-call] _run executes only on the dedicated pump thread (started in start()), never on an event loop

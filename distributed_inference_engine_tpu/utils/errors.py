@@ -1,0 +1,99 @@
+"""Typed failure taxonomy for the serving plane.
+
+Every error the coordinator can observe falls into exactly one of four
+classes, decided structurally (isinstance checks and typed attributes),
+never by substring-matching ``str(exc)``:
+
+- ``transport`` — the bytes didn't make it: socket errors, timeouts,
+  torn or garbled frames. Retriable on an alternate worker; dents the
+  failed worker's health.
+- ``shed`` — the worker refused admission (queue full, queue-deadline
+  shed, draining). Retriable elsewhere; does NOT dent health — an
+  overloaded worker is busy, not broken (r3 finding).
+- ``deadline`` — the request aged out of its *own* per-request budget.
+  Never retried: the client has already stopped caring, and replaying
+  an expired request on another worker only wastes its steps too.
+- ``application`` — everything else (bad request, handler bug).
+  Not retried; retrying a deterministic failure can't help.
+
+The class carried over the wire is the RPC envelope's ``error_kind`` /
+``error_detail`` pair (see ``utils/rpc.py``), populated from the
+``rpc_error_kind`` / ``rpc_error_detail`` attributes of the raising
+exception — so classification survives serialization without any
+string parsing on the far side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .framing import FrameError
+
+# taxonomy class names
+TRANSPORT = "transport"
+SHED = "shed"
+DEADLINE = "deadline"
+APPLICATION = "application"
+
+# wire-level error kinds (``rpc_error_kind`` values)
+KIND_OVERLOADED = "overloaded"
+KIND_DEADLINE = "deadline"
+
+# shed-reason details (``rpc_error_detail`` values for KIND_OVERLOADED)
+REASON_QUEUE_FULL = "queue_full"
+REASON_DEADLINE = "deadline"
+REASON_DRAINING = "draining"
+
+# The transport family: anything here means the connection (not the
+# request) failed. FrameError is included deliberately — a garbled frame
+# poisons the connection exactly like a torn one, and the chaos menu
+# injects both.
+TRANSPORT_ERRORS = (
+    OSError,
+    ConnectionError,
+    asyncio.TimeoutError,
+    asyncio.IncompleteReadError,
+    EOFError,
+    FrameError,
+)
+
+
+def error_kind(exc: BaseException) -> str:
+    """The typed wire kind an exception carries, or ``""``."""
+    return str(
+        getattr(exc, "rpc_error_kind", "") or getattr(exc, "kind", "") or "")
+
+
+def error_detail(exc: BaseException) -> str:
+    """The typed wire detail an exception carries, or ``""``."""
+    for attr in ("rpc_error_detail", "detail", "reason"):
+        v = getattr(exc, attr, "")
+        if v:
+            return str(v)
+    return ""
+
+
+def classify(exc: BaseException) -> str:
+    """Map any exception into the four-class taxonomy. Structural only."""
+    if isinstance(exc, TRANSPORT_ERRORS):
+        return TRANSPORT
+    kind = error_kind(exc)
+    if kind == KIND_OVERLOADED:
+        return SHED
+    if kind == KIND_DEADLINE:
+        return DEADLINE
+    return APPLICATION
+
+
+def shed_reason(exc: BaseException) -> str:
+    """Why a shed happened (``queue_full`` / ``deadline`` / ``draining``),
+    read from typed attributes only — replaces the old
+    ``"deadline" in str(exc)`` matching."""
+    return error_detail(exc) or REASON_QUEUE_FULL
+
+
+def retriable_elsewhere(exc: BaseException) -> bool:
+    """Whether an alternate worker could plausibly succeed where this
+    one failed: transport failures and sheds, never deadline or
+    application errors."""
+    return classify(exc) in (TRANSPORT, SHED)

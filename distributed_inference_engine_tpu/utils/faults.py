@@ -1,0 +1,139 @@
+"""Deterministic fault injection for the framed-RPC plane.
+
+A ``FaultPlan`` is a seeded, shareable decision oracle: every potential
+injection point (client call, server dispatch) asks it whether to
+inject, identified by ``(scope, site, verb)`` — e.g.
+``("worker-2", "server", "generate")``. Decisions are a pure function of
+``(seed, spec index, scope, site, verb, call ordinal)``, where the
+ordinal is a per-key counter: the Nth ``generate`` dispatched to
+``worker-2`` gets the same verdict on every run with the same seed,
+regardless of how the event loop interleaves unrelated traffic. That
+per-key (rather than global-RNG) construction is what makes a chaos run
+reproducible under async scheduling jitter.
+
+Every injection is appended to ``plan.log`` so a test can assert the
+exact fault sequence (compare sorted — interleaving may reorder entries
+across keys, never within one).
+
+The fault menu (``FaultSpec.kind``):
+
+- client site: ``connect_refused`` (call fails before any bytes move),
+  ``slow`` (delay before the request frame), ``stall`` (request frame
+  written, then the connection is torn mid-exchange).
+- server site: ``slow`` (delay before dispatch), ``drop`` (request
+  consumed, no response, connection closed), ``garble`` (response
+  replaced by bytes that fail frame-magic validation).
+
+Hooks live in ``utils/rpc.py`` behind a ``fault_plan`` attribute that
+defaults to ``None`` — the production path pays one attribute load.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+CLIENT = "client"
+SERVER = "server"
+
+CLIENT_KINDS = ("connect_refused", "slow", "stall")
+SERVER_KINDS = ("slow", "drop", "garble")
+
+
+@dataclass
+class FaultSpec:
+    """One line of the fault menu.
+
+    ``rate`` is the per-call injection probability; ``verbs`` / ``scopes``
+    restrict matching (empty = match all; scopes match by substring so a
+    spec can target ``"worker-2"`` or a ``host:port``). ``site`` must be
+    ``"client"`` or ``"server"``. ``max_injections`` caps how many times
+    the spec fires in total (0 = unlimited).
+    """
+
+    kind: str
+    rate: float
+    site: str = SERVER
+    delay_s: float = 0.05
+    verbs: Tuple[str, ...] = ()
+    scopes: Tuple[str, ...] = ()
+    max_injections: int = 0
+
+
+@dataclass
+class InjectedFault:
+    scope: str
+    site: str
+    verb: str
+    ordinal: int
+    kind: str
+
+    def key(self) -> Tuple[str, str, str, int, str]:
+        return (self.scope, self.site, self.verb, self.ordinal, self.kind)
+
+
+def _unit(seed: int, spec_idx: int, scope: str, site: str, verb: str,
+          ordinal: int) -> float:
+    """Deterministic U[0,1) from the full decision coordinates (sha256,
+    not Python's salted hash)."""
+    h = hashlib.sha256(
+        f"{seed}|{spec_idx}|{scope}|{site}|{verb}|{ordinal}".encode()
+    ).digest()
+    return int.from_bytes(h[:8], "big") / 2.0 ** 64
+
+
+class FaultPlan:
+    """Seeded injection oracle shared by every hook in one chaos run."""
+
+    def __init__(self, seed: int = 0,
+                 specs: Sequence[FaultSpec] = ()) -> None:
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = list(specs)
+        self.log: List[InjectedFault] = []
+        self._ordinals: Dict[Tuple[str, str, str], int] = {}
+        self._fired: List[int] = [0] * len(self.specs)
+
+    def draw(self, scope: str, site: str, verb: str) -> Optional[FaultSpec]:
+        """Decide whether the call identified by (scope, site, verb) at
+        its current per-key ordinal should fault. First matching spec
+        wins. Returns the spec to apply, or None."""
+        key = (scope, site, verb)
+        n = self._ordinals.get(key, 0)
+        self._ordinals[key] = n + 1
+        for i, spec in enumerate(self.specs):
+            if spec.site != site:
+                continue
+            if spec.verbs and verb not in spec.verbs:
+                continue
+            if spec.scopes and not any(s in scope for s in spec.scopes):
+                continue
+            if spec.max_injections and self._fired[i] >= spec.max_injections:
+                continue
+            if _unit(self.seed, i, scope, site, verb, n) < spec.rate:
+                self._fired[i] += 1
+                self.log.append(InjectedFault(scope, site, verb, n, spec.kind))
+                return spec
+        return None
+
+    def injected_count(self, scope: str = "") -> int:
+        """Total injections, optionally filtered to one scope (exact)."""
+        if not scope:
+            return len(self.log)
+        return sum(1 for e in self.log if e.scope == scope)
+
+    def sequence(self) -> List[Tuple[str, str, str, int, str]]:
+        """Order-independent canonical fault sequence for reproducibility
+        assertions (sorted: async interleaving may reorder the log across
+        keys, never within one)."""
+        return sorted(e.key() for e in self.log)
+
+
+def default_menu(rate: float = 0.05, delay_s: float = 0.02,
+                 verbs: Tuple[str, ...] = ()) -> List[FaultSpec]:
+    """The full menu at a uniform rate — what the chaos harness runs."""
+    out = [FaultSpec(kind=k, rate=rate, site=CLIENT, delay_s=delay_s,
+                     verbs=verbs) for k in CLIENT_KINDS]
+    out += [FaultSpec(kind=k, rate=rate, site=SERVER, delay_s=delay_s,
+                      verbs=verbs) for k in SERVER_KINDS]
+    return out

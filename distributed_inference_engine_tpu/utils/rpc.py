@@ -62,6 +62,10 @@ class FramedRPCClient:
     instead of serializing behind a single socket lock.
     """
 
+    # optional chaos injection oracle (utils/faults.FaultPlan); None in
+    # production — the hot path pays one attribute load
+    fault_plan = None
+
     def __init__(self, host: str, port: int,
                  timeout: float = 30.0,
                  max_frame: int = 64 * 1024 * 1024,
@@ -74,6 +78,7 @@ class FramedRPCClient:
         # idle connections ready for reuse; _total counts idle + in-use
         self._free: list = []   # [(reader, writer)]
         self._total = 0
+        self._inuse: set = set()  # (reader, writer) with a call in flight
         self._cond = asyncio.Condition()
         self._seq = 0
         self._closed = False
@@ -118,6 +123,7 @@ class FramedRPCClient:
         which a cancelled caller could leak the slot (the same discipline
         as ``_discard_nowait``). List mutation is loop-thread-atomic;
         waiters are notified by a detached task."""
+        self._inuse.discard(conn)
         if self._closed:
             # close() ran while this call was in flight — don't re-pool a
             # socket nobody will ever close again
@@ -130,6 +136,7 @@ class FramedRPCClient:
         """Synchronous discard: safe to run from a CancelledError handler
         (any further ``await`` there could be interrupted again, leaking
         the slot)."""
+        self._inuse.discard(conn)
         _reader, writer = conn
         writer.close()
         self._total -= 1
@@ -151,6 +158,19 @@ class FramedRPCClient:
         except RuntimeError:      # no running loop (teardown) — no waiters
             pass
 
+    def abort_inflight(self) -> int:
+        """Force-close every connection with a call in flight: the pending
+        reads fail immediately as transport errors instead of waiting out
+        the full dispatch timeout against a peer that is being removed —
+        the caller's retry policy then requeues the work on an alternate.
+        Slot accounting stays with the in-flight caller (its discard path
+        runs when the read fails); this only tears the sockets."""
+        n = 0
+        for _reader, writer in list(self._inuse):
+            writer.close()
+            n += 1
+        return n
+
     async def close(self) -> None:
         """Close idle connections and mark the pool closed: in-flight calls
         discard their connection when they finish instead of re-pooling it,
@@ -165,6 +185,7 @@ class FramedRPCClient:
             writer.close()
             try:
                 await writer.wait_closed()
+            # graftlint: ok[swallowed-transport-error] pool teardown of an already-closing socket — there is no call left to fail
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
@@ -202,10 +223,24 @@ class FramedRPCClient:
         self._seq += 1
         msg = {"method": method, "id": f"{id(self):x}-{self._seq}", **params}
         effective = timeout if timeout is not None else self.timeout
+        fault = None
+        if self.fault_plan is not None:
+            fault = self.fault_plan.draw(self.address, "client", method)
+            if fault is not None and fault.kind == "connect_refused":
+                raise ConnectionRefusedError(
+                    f"chaos: injected connection refusal to {self.address}")
+            if fault is not None and fault.kind == "slow":
+                await asyncio.sleep(fault.delay_s)
         self._closed = False          # calling a closed client reopens it
         conn = await self._acquire(effective)
+        self._inuse.add(conn)
         try:
             await write_frame(conn[1], msg)
+            if fault is not None and fault.kind == "stall":
+                # the request frame is on the wire; tear the connection
+                # before the response — the worst spot in the exchange
+                raise ConnectionResetError(
+                    f"chaos: injected mid-frame stall to {self.address}")
             while True:
                 frame = await read_frame(
                     conn[0], max_frame=self.max_frame, timeout=effective,
@@ -296,6 +331,14 @@ class FramedServerMixin:
     _stream_methods: Dict[str, Callable[..., Awaitable[Any]]] = {}
     _conn_writers: set
     max_frame_bytes: int = 64 * 1024 * 1024
+    # optional chaos injection oracle (utils/faults.FaultPlan); None in
+    # production
+    fault_plan = None
+
+    def _fault_scope(self) -> str:
+        """Identity this server reports to the FaultPlan (workers override
+        via their ``worker_id`` attribute)."""
+        return getattr(self, "worker_id", "") or type(self).__name__
 
     async def _handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -322,12 +365,32 @@ class FramedServerMixin:
                             reader, max_frame=self.max_frame_bytes,
                             timeout=None,
                         )
+                # graftlint: ok[swallowed-transport-error] client hung up; leaving the serve loop (and closing the connection) IS the handling
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break  # client closed
                 except FrameError as e:
                     await write_frame(writer, {"success": False,
                                                "error": f"bad frame: {e}"})
                     break
+                if self.fault_plan is not None and isinstance(msg, dict):
+                    spec = self.fault_plan.draw(
+                        self._fault_scope(), "server",
+                        str(msg.get("method", "")))
+                    if spec is not None:
+                        if spec.kind == "drop":
+                            break   # request consumed, no response, close
+                        if spec.kind == "garble":
+                            # bytes that fail frame-magic validation: the
+                            # client sees FrameError (transport class)
+                            writer.write(b"\x00GARBLED\x00FRAME\x00")
+                            try:
+                                await writer.drain()
+                            # graftlint: ok[swallowed-transport-error] injected garble fault: the CLIENT is meant to see the failure (FrameError); the server just tears the conn
+                            except (ConnectionResetError, BrokenPipeError):
+                                pass
+                            break
+                        if spec.kind == "slow":
+                            await asyncio.sleep(spec.delay_s)
                 if (isinstance(msg, dict)
                         and msg.get("method") in self._stream_methods):
                     response = await self._dispatch_stream(msg, writer)
@@ -337,6 +400,7 @@ class FramedServerMixin:
                     response = await self._dispatch(msg)
                 try:
                     await write_frame(writer, response)
+                # graftlint: ok[swallowed-transport-error] client gone mid-response — nobody left to tell; the conn closes below
                 except (ConnectionResetError, BrokenPipeError):
                     break                     # client gone — nobody to tell
         finally:
@@ -344,6 +408,7 @@ class FramedServerMixin:
             writer.close()
             try:
                 await writer.wait_closed()
+            # graftlint: ok[swallowed-transport-error] teardown of a socket that is already dead — nothing to mark at this layer
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
@@ -363,6 +428,7 @@ class FramedServerMixin:
             result = await self._run_handler(method, handler, msg)
             response = {"id": req_id, "success": True, **extra,
                         "result": result}
+        # graftlint: ok[swallowed-transport-error] the timeout becomes an error response frame — the client sees and counts it
         except asyncio.TimeoutError:
             response = {"id": req_id, "success": False, **extra,
                         "error": self._timeout_error(method)}
@@ -445,6 +511,7 @@ class FramedServerMixin:
             if b"\r\n\r\n" not in raw:
                 raw += await asyncio.wait_for(
                     reader.readuntil(b"\r\n\r\n"), timeout=5.0)
+        # graftlint: ok[swallowed-transport-error] best-effort HTTP side-door: a scraper that hangs up mid-request just loses its scrape
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 asyncio.LimitOverrunError, ConnectionResetError):
             return
@@ -473,6 +540,7 @@ class FramedServerMixin:
                 f"Content-Length: {len(body)}\r\n"
                 "Connection: close\r\n\r\n".encode("latin-1") + payload)
             await writer.drain()
+        # graftlint: ok[swallowed-transport-error] scraper disconnected before the HTTP response; the connection closes right after
         except (ConnectionResetError, BrokenPipeError, OSError):
             pass
 

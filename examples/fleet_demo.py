@@ -78,12 +78,26 @@ async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> Non
             print(f"  request {i} FAILED: {e}")
 
     half = n_requests // 2
+    q3 = half + (n_requests - half) // 2
     await asyncio.gather(*(one(i) for i in range(half)))
     if kill and workers:
         victim = workers[0]
         print(f"  !! killing worker {victim.worker_id} mid-run")
         await victim.stop()
-    await asyncio.gather(*(one(half + i) for i in range(n_requests - half)))
+    await asyncio.gather(*(one(half + i) for i in range(q3 - half)))
+    if kill:
+        # elastic respawn: a fresh worker joins mid-run and deploy_model's
+        # idempotent scale-out loads the model onto it only
+        respawn = WorkerServer(ServerConfig(worker_id=f"w{n_workers}",
+                                            host="127.0.0.1", port=0))
+        await respawn.start()
+        h, p = respawn.address
+        coord.add_worker(respawn.worker_id, h, p)
+        await coord.deploy_model(model)
+        served[respawn.worker_id] = 0
+        workers.append(respawn)
+        print(f"  ++ respawned capacity as {respawn.worker_id} on port {p}")
+    await asyncio.gather(*(one(q3 + i) for i in range(n_requests - q3)))
     wall = time.perf_counter() - t0
 
     print(f"  {n_requests} requests in {wall:.2f}s "

@@ -62,6 +62,19 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2c: fast chaos leg -----------------------------------------
+# fault-injection / failover tests (-m chaos): seeded FaultPlan faults,
+# deadline budgets, graceful drain, mid-stream kill + resume. A broken
+# failure path fails here in seconds, before the full sweep.
+echo "== chaos (-m 'chaos and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'chaos and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: chaos leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.
@@ -77,7 +90,7 @@ fi
 # --- stage 3: tier-1 tests (verbatim ROADMAP.md verify command) -------
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

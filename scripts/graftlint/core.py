@@ -193,6 +193,7 @@ def _load_rule_modules() -> None:
     from . import hotpath_rules  # noqa: F401
     from . import import_rules   # noqa: F401
     from . import jit_rules      # noqa: F401
+    from . import robustness_rules  # noqa: F401
 
 
 # --------------------------------------------------------------- baseline

@@ -1,0 +1,131 @@
+"""Rule family 5: robustness — transport failures must move health state.
+
+The chaos-hardening round (faults, retry budgets, breakers, drain) only
+works if every layer that can SEE a transport failure also COUNTS it:
+the LB breaker, the router health marks, and the coordinator's retry
+budget are all fed by except-handlers. A handler in the serving plane
+that catches `ConnectionError`/`OSError`/broad `Exception` and simply
+moves on hides a dead worker from every one of those mechanisms — the
+fleet keeps routing to it until the health loop happens to notice.
+
+``swallowed-transport-error``: an ``except`` in a serving-plane module
+(api/, cluster/, serving/, utils/rpc.py) that catches a transport-ish or
+broad exception type and neither re-raises, nor calls a known
+health-bookkeeping method, nor touches a health/error field, nor even
+reads the bound exception. Sites that are genuinely benign (best-effort
+cleanup, optional probes) say so with a pragma — that reason string IS
+the audit trail the chaos round asked for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .async_rules import _in_serving_plane
+from .core import Finding, ModuleInfo, Project, Rule, register
+
+# exception names that signal "the wire or the peer broke" — including
+# the taxonomy tuple itself and framing-layer corruption
+_TRANSPORT_NAMES = {
+    "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+    "ConnectionRefusedError", "ConnectionAbortedError", "BrokenPipeError",
+    "TimeoutError", "IncompleteReadError", "EOFError", "FrameError",
+    "TRANSPORT_ERRORS",
+}
+# broad catches swallow transport errors along with everything else
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+# calls that count as "the failure moved health/bookkeeping state"
+_HEALTH_CALLS = {
+    "mark_worker_failure", "mark_worker_success", "quarantine",
+    "update_stats", "check_worker", "abort_inflight",
+    "_record_failure", "_record_success", "_open_breaker",
+    "_discard_nowait", "_notify_detached", "_on_handler_error",
+}
+# attribute assignment targets that count the same way
+_HEALTH_ATTR_HINTS = ("health", "fail", "error", "breaker", "drain",
+                      "retr")
+
+
+def _caught_labels(handler: ast.ExceptHandler) -> List[str]:
+    """Names an except clause catches (flattening tuples); empty = bare."""
+    t = handler.type
+    if t is None:
+        return []
+    nodes = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    out: List[str] = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):      # asyncio.TimeoutError etc.
+            out.append(n.attr)
+    return out
+
+
+def _is_candidate(handler: ast.ExceptHandler) -> str:
+    """Non-empty display label when the clause can swallow transport."""
+    labels = _caught_labels(handler)
+    if handler.type is None:
+        return "bare except"
+    hits = [l for l in labels
+            if l in _TRANSPORT_NAMES or l in _BROAD_NAMES]
+    if hits:
+        return "except " + "/".join(hits)
+    return ""
+
+
+def _acknowledges_failure(handler: ast.ExceptHandler) -> bool:
+    """True when the handler provably does something with the failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name in _HEALTH_CALLS:
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and any(
+                        h in t.attr for h in _HEALTH_ATTR_HINTS):
+                    return True
+        # reading the bound exception (logging it, wrapping it, returning
+        # it) is at least not a SILENT swallow
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name:
+            return True
+    return False
+
+
+@register
+class SwallowedTransportError(Rule):
+    id = "swallowed-transport-error"
+    family = "robustness"
+    severity = "error"
+    doc = ("serving-plane except clause catches a transport-ish or broad "
+           "exception and neither re-raises, marks worker health, nor "
+           "reads the bound error — a dead peer stays invisible to the "
+           "breaker/retry machinery")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> Iterable[Finding]:
+        if mod.tree is None or not _in_serving_plane(mod.relpath):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            label = _is_candidate(node)
+            if not label or _acknowledges_failure(node):
+                continue
+            out.append(self.finding(
+                mod, node.lineno,
+                f"`{label}` swallows a transport failure without marking "
+                f"health or reading the error — feed it to the health "
+                f"machinery (mark_worker_failure/_record_failure), "
+                f"re-raise, or pragma why it is benign"))
+        return out

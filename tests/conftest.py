@@ -57,6 +57,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: graftlint static-analysis tests (rule fixtures, "
         "pragma/baseline mechanics, zero-findings gate on the real tree)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / failover tests (seeded "
+        "FaultPlan, deadlines, drain, kill/respawn; fast leg: pytest -m "
+        "'chaos and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

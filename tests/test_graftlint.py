@@ -264,6 +264,107 @@ def test_async_orphan_task_fires_and_retained_silent():
     assert "async-orphan-task" not in rules_fired(good)
 
 
+# ------------------------------------------- swallowed-transport-error
+
+SWALLOW_BAD = """
+    async def probe(self, wid):
+        try:
+            await self.client.ping()
+        except ConnectionError:
+            pass
+"""
+
+SWALLOW_BARE = """
+    def close(self):
+        try:
+            self.sock.close()
+        except:
+            pass
+"""
+
+
+def test_swallowed_transport_error_fires_in_serving_plane():
+    assert "swallowed-transport-error" in rules_fired(
+        SWALLOW_BAD, relpath="pkg/api/x.py")
+    assert "swallowed-transport-error" in rules_fired(
+        SWALLOW_BARE, relpath="pkg/cluster/x.py")
+    broad = """
+        async def sweep(self):
+            try:
+                await self.check_all()
+            except Exception:
+                self.log.exception("sweep failed")
+    """
+    assert "swallowed-transport-error" in rules_fired(
+        broad, relpath="pkg/serving/x.py")
+
+
+def test_swallowed_transport_error_silent_outside_serving_plane():
+    assert "swallowed-transport-error" not in rules_fired(
+        SWALLOW_BAD, relpath="pkg/models/x.py")
+
+
+def test_swallowed_transport_error_silent_when_acknowledged():
+    marks = """
+        async def probe(self, wid):
+            try:
+                await self.client.ping()
+            except (OSError, ConnectionError):
+                self.mark_worker_failure(wid)
+    """
+    reraises = """
+        async def fetch(self):
+            try:
+                return await self.client.call("metrics")
+            except ConnectionResetError:
+                raise RuntimeError("worker gone")
+    """
+    reads_bound = """
+        async def fetch(self):
+            try:
+                return await self.client.call("metrics")
+            except TimeoutError as e:
+                self.log.warning("slow worker: %s", e)
+                return None
+    """
+    moves_field = """
+        async def probe(self, wid):
+            try:
+                await self.client.ping()
+            except BrokenPipeError:
+                self._consecutive_failures += 1
+    """
+    app_error = """
+        async def fetch(self):
+            try:
+                return await self.client.call("metrics")
+            except KeyError:
+                return None
+    """
+    for src in (marks, reraises, reads_bound, app_error):
+        assert "swallowed-transport-error" not in rules_fired(
+            src, relpath="pkg/api/x.py"), src
+    # AugAssign to a health-ish attribute counts as acknowledgement
+    fired = {f.rule for f in lint_source(
+        textwrap.dedent(moves_field), relpath="pkg/api/x.py")
+        if f.suppressed_by is None}
+    assert "swallowed-transport-error" not in fired
+
+
+def test_swallowed_transport_error_pragma_suppresses():
+    src = """
+        async def close(self):
+            try:
+                await self.writer.wait_closed()
+            # graftlint: ok[swallowed-transport-error] teardown of a dead socket
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+    """
+    findings = lint_source(textwrap.dedent(src), relpath="pkg/api/x.py")
+    mine = [f for f in findings if f.rule == "swallowed-transport-error"]
+    assert mine and all(f.suppressed_by == "pragma" for f in mine)
+
+
 # ------------------------------------------------------------------- pragmas
 
 def test_pragma_suppresses_same_line_and_line_above():
