@@ -41,7 +41,7 @@ from ..cluster.load_balancer import (
     NoHealthyWorkerError,
 )
 from ..cluster.registry import ModelRegistry, ModelStatus
-from ..cluster.router import Router, RoutingError
+from ..cluster.router import Router, RoutingError, WorkerHealth
 from ..cluster.worker import (
     DECODE_PEER_UNREACHABLE,
     WorkerClient,
@@ -88,6 +88,18 @@ class CoordinatorConfig:
     retry_jitter_frac: float = 0.25
     retry_seed: Optional[int] = None      # None ⇒ nondeterministic jitter
     drain_timeout_s: float = 30.0         # default budget for drain_worker
+    # supervisor loop (start_supervisor): auto-respawn workers the health
+    # machinery declares dead, via a pluggable restart hook. Backoff
+    # between failed attempts is seeded by retry_seed (same jitter source
+    # as dispatch retries, so chaos runs reproduce); the crash-loop
+    # breaker gives up after `threshold` failed respawns inside `window`
+    # and marks the worker's shards degraded instead of flapping forever.
+    supervisor_interval_s: float = 1.0
+    supervisor_backoff_base_s: float = 0.5
+    supervisor_backoff_max_s: float = 15.0
+    supervisor_crashloop_threshold: int = 3
+    supervisor_crashloop_window_s: float = 60.0
+    supervisor_load_timeout_s: float = 600.0
 
     @classmethod
     def from_config(cls, cfg: Config) -> "CoordinatorConfig":
@@ -104,6 +116,17 @@ class _DisaggPool:
     prefill_ids: List[str]
     decode_ids: List[str]
     rr: int = 0
+
+
+@dataclass
+class _SupervisedWorker:
+    """Per-worker respawn bookkeeping for the supervisor loop."""
+
+    failures: List[float] = field(default_factory=list)  # failed-attempt
+                                                         # monotonic stamps
+    attempts: int = 0            # consecutive failures (backoff exponent)
+    next_attempt: float = 0.0    # monotonic gate for the next try
+    respawning: bool = False     # an attempt is in flight this sweep
 
 
 class Coordinator:
@@ -154,6 +177,13 @@ class Coordinator:
         self._stream_resumes = 0        # mid-stream failovers with replay
         self._deadline_expired = 0      # client-visible deadline outcomes
         self._drains = 0                # graceful worker drains completed
+        # supervisor loop state (start_supervisor arms it)
+        self._restart_hook = None
+        self._supervisor_task: Optional[asyncio.Task] = None
+        self._supervised: Dict[str, _SupervisedWorker] = {}
+        self._degraded: set = set()     # crash-looped ids (given up)
+        self._supervisor_respawns = 0
+        self._supervisor_crashloop_opens = 0
         # seeded jitter source for retry backoff (retry_seed pins it for
         # reproducible chaos runs)
         self._retry_rand = random.Random(self.config.retry_seed)
@@ -181,11 +211,15 @@ class Coordinator:
         await self.batcher.start()
         await self.router.start()
         await self.lb.start()
+        if self._restart_hook is not None and self._supervisor_task is None:
+            self._supervisor_task = asyncio.create_task(
+                self._supervisor_loop())
 
     async def stop(self) -> None:
         if not self._running:
             return
         self._running = False
+        await self.stop_supervisor()
         await self.batcher.stop()
         await self.router.stop()
         await self.lb.stop()
@@ -230,6 +264,163 @@ class Coordinator:
         if remove:
             self.remove_worker(worker_id)
         return summary
+
+    # -- supervisor: auto-respawn dead workers ------------------------------
+
+    def start_supervisor(self, restart_hook) -> None:
+        """Arm the auto-respawn loop (the elastic half of the PR 7 health
+        machinery): when the router declares a worker UNHEALTHY, the
+        supervisor calls ``await restart_hook(worker_id, info)`` — which
+        must bring a replacement process up (typically a seconds-scale
+        artifact cold-start, ``engine/artifact.py``) and return its
+        ``(host, port)`` — then re-registers the worker under its ORIGINAL
+        id (registry shards stay valid), reloads its models, and re-enters
+        it into LB rotation half-open so the first real request is the
+        trial probe. Failed attempts back off exponentially with seeded
+        jitter; ``supervisor_crashloop_threshold`` failures inside
+        ``supervisor_crashloop_window_s`` open the crash-loop breaker —
+        the worker's shards are marked FAILED, it leaves both planes, and
+        the survivors keep serving (``supervisor_reset`` re-arms it)."""
+        self._restart_hook = restart_hook
+        if self._running and self._supervisor_task is None:
+            self._supervisor_task = asyncio.create_task(
+                self._supervisor_loop())
+
+    async def stop_supervisor(self) -> None:
+        task, self._supervisor_task = self._supervisor_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    def supervisor_reset(self, worker_id: str) -> bool:
+        """Operator re-arm after a crash-loop open (e.g. the artifact was
+        repaired): clears the breaker and failure window so the supervisor
+        will try ``worker_id`` again. Returns True if it was degraded."""
+        was = worker_id in self._degraded
+        self._degraded.discard(worker_id)
+        self._supervised.pop(worker_id, None)
+        return was
+
+    async def _supervisor_loop(self) -> None:
+        while self._running:
+            try:
+                await self._supervisor_sweep()
+            # graftlint: ok[swallowed-transport-error] per-attempt failures are handled (counted + backoff) inside the sweep; this guards the loop itself from dying
+            except Exception:
+                logger.exception("supervisor sweep failed")
+            await asyncio.sleep(self.config.supervisor_interval_s)
+
+    async def _supervisor_sweep(self) -> None:
+        now = time.monotonic()
+        for wid, info in list(self.router.workers.items()):
+            if info.health is not WorkerHealth.UNHEALTHY:
+                continue
+            if wid in self._degraded:
+                continue
+            st = self._supervised.setdefault(wid, _SupervisedWorker())
+            if st.respawning or now < st.next_attempt:
+                continue
+            window = self.config.supervisor_crashloop_window_s
+            st.failures = [t for t in st.failures if now - t <= window]
+            if len(st.failures) >= self.config.supervisor_crashloop_threshold:
+                self._open_crashloop(wid)
+                continue
+            st.respawning = True
+            try:
+                await self._respawn_worker(wid, info)
+                st.failures.clear()
+                st.attempts = 0
+            except Exception as e:
+                t = time.monotonic()
+                st.failures.append(t)
+                st.attempts += 1
+                delay = self._supervisor_backoff_s(st.attempts - 1)
+                st.next_attempt = t + delay
+                logger.warning(
+                    "supervisor: respawn of %s failed (%s: %s) — "
+                    "attempt %d, next try in %.2fs (%d/%d failures in "
+                    "window)", wid, type(e).__name__, e, st.attempts,
+                    delay, len(st.failures),
+                    self.config.supervisor_crashloop_threshold)
+                if (len(st.failures)
+                        >= self.config.supervisor_crashloop_threshold):
+                    # open NOW rather than waiting out the backoff: the
+                    # verdict is already in
+                    self._open_crashloop(wid)
+            finally:
+                st.respawning = False
+
+    async def _respawn_worker(self, worker_id: str, info) -> None:
+        """One respawn attempt: hook → re-register (same id) → reload this
+        worker's models → rejoin LB rotation half-open."""
+        if self._restart_hook is None:
+            raise RuntimeError("supervisor armed without a restart hook")
+        logger.warning("supervisor: worker %s is unhealthy — respawning",
+                       worker_id)
+        host_port = await self._restart_hook(worker_id, info)
+        if not host_port:
+            raise RuntimeError(
+                f"restart hook returned {host_port!r} for {worker_id}")
+        host, port = host_port
+        meta = dict(info.metadata)
+        # tear down the old registration only once the hook has produced a
+        # replacement — keeping the id stable keeps registry shards valid
+        self.remove_worker(worker_id)
+        self.add_worker(worker_id, host, int(port), **meta)
+        for name, mcfg in self._model_configs.items():
+            shards = [s for s in self.registry.all_shards(name, mcfg.version)
+                      if s.worker_id == worker_id]
+            if not shards:
+                continue
+            # a successful load RPC is the proof of life — a hook that
+            # spawned a zombie fails here and counts as a failed attempt
+            await self.router.client_for(worker_id).load_model(
+                mcfg, timeout=self.config.supervisor_load_timeout_s)
+            for s in shards:
+                s.status = ModelStatus.READY
+        self.router.mark_worker_success(worker_id)
+        # rejoin CAUTIOUSLY: half-open means the next pick is the one
+        # trial probe — success closes the circuit, failure re-opens it
+        self.lb.enter_half_open(worker_id)
+        self._supervisor_respawns += 1
+        logger.warning("supervisor: respawned %s at %s:%s (LB half-open)",
+                       worker_id, host, port)
+
+    def _open_crashloop(self, worker_id: str) -> None:
+        if worker_id in self._degraded:
+            return
+        self._degraded.add(worker_id)
+        self._supervisor_crashloop_opens += 1
+        failed = 0
+        for name, mcfg in self._model_configs.items():
+            for s in self.registry.all_shards(name, mcfg.version):
+                if s.worker_id == worker_id:
+                    s.status = ModelStatus.FAILED
+                    failed += 1
+        # out of both planes: routing fails over deterministically to the
+        # survivors instead of retrying a corpse
+        self.remove_worker(worker_id)
+        logger.error(
+            "supervisor: crash-loop breaker OPEN for %s (%d failed "
+            "respawns in %.0fs) — giving up; %d shard(s) marked FAILED, "
+            "surviving workers keep serving. supervisor_reset(%r) re-arms.",
+            worker_id, self.config.supervisor_crashloop_threshold,
+            self.config.supervisor_crashloop_window_s, failed, worker_id)
+
+    def _supervisor_backoff_s(self, attempt: int) -> float:
+        """Exponential backoff with seeded jitter for respawn ``attempt``
+        (0-based) — same jitter source as dispatch retries, so chaos runs
+        reproduce."""
+        base = self.config.supervisor_backoff_base_s
+        if base <= 0:
+            return 0.0
+        delay = min(self.config.supervisor_backoff_max_s,
+                    base * (2 ** attempt))
+        return delay * (1.0 + self.config.retry_jitter_frac
+                        * self._retry_rand.random())
 
     async def deploy_model(
         self,
@@ -1308,6 +1499,12 @@ class Coordinator:
             "stream_resumes": self._stream_resumes,
             "deadline_expired": self._deadline_expired,
             "drains": self._drains,
+            "supervisor_respawns": self._supervisor_respawns,
+            "supervisor_crashloop_opens": self._supervisor_crashloop_opens,
+            "supervisor": {
+                "armed": self._restart_hook is not None,
+                "degraded_workers": sorted(self._degraded),
+            },
             "cache": self.cache.get_stats(),
             "batcher": self.batcher.get_stats(),
             "router": self.router.get_stats(),

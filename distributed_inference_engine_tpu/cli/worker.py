@@ -71,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="config file (.json/.toml/.yaml): server/model "
                         "settings come from the file; explicit multihost "
                         "flags still override its multihost section")
+    p.add_argument("--artifact-dir", default="",
+                   help="pre-fused serving-artifact root: each preloaded "
+                        "model cold-starts from <dir>/<name> when a "
+                        "committed artifact exists there (and writes one "
+                        "after a slow-path load, so the NEXT boot is "
+                        "fast); per-model metadata artifact= wins")
     p.add_argument("--multihost", action="store_true",
                    help="join the jax.distributed runtime before loading "
                         "models (TPU pod slices: run one worker per host; "
@@ -116,6 +122,14 @@ async def amain(args: argparse.Namespace) -> None:
         )
         print(f"multihost: process {idx}", flush=True)
 
+    if args.artifact_dir:
+        import os
+
+        for m in models:
+            # per-model metadata artifact= wins over the shared root
+            m.metadata.setdefault(
+                "artifact", os.path.join(args.artifact_dir, m.name))
+
     worker = WorkerServer(server_cfg)
     # preload BEFORE announcing the address: the "listening" line is the
     # readiness signal orchestration scripts wait on, and Ctrl-C during a
@@ -124,7 +138,11 @@ async def amain(args: argparse.Namespace) -> None:
     for m in models:
         print(f"loading model {m.name} ({m.architecture})...", flush=True)
         await worker.load_model_async(m)
-        print(f"loaded model {m.name}", flush=True)
+        load_s = worker._last_load_s.get(m.name, 0.0)
+        hit = getattr(worker.engines.get(m.name), "artifact_manifest",
+                      None) is not None
+        print(f"loaded model {m.name} in {load_s:.2f}s"
+              f"{' [artifact cold-start]' if hit else ''}", flush=True)
     host, port = await worker.start(install_signal_handlers=True)
     print(f"worker {worker.worker_id} listening on {host}:{port}", flush=True)
     await worker.serve_forever()

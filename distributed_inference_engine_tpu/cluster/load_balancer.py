@@ -219,6 +219,20 @@ class LoadBalancer:
         self._open_breaker(s)
         return True
 
+    def enter_half_open(self, worker_id: str) -> bool:
+        """Put a worker straight into HALF_OPEN (the supervisor's rejoin
+        path after a respawn): the next selection or health probe is its
+        one trial — success closes the circuit, failure re-opens it. Skips
+        the usual OPEN→cooldown wait because the respawn itself is the
+        evidence the process is fresh."""
+        s = self.workers.get(worker_id)
+        if s is None:
+            return False
+        s.consecutive_failures = 0
+        s.breaker_state = BREAKER_HALF_OPEN
+        s.breaker_opened_at = time.monotonic()
+        return True
+
     def healthy_workers(self) -> List[WorkerStats]:
         return [s for s in self.workers.values() if self._is_healthy(s)]
 

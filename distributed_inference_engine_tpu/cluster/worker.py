@@ -219,6 +219,14 @@ class WorkerServer(FramedServerMixin):
         self._drain_count = 0
         self._deadline_expired_count = 0
         self.latency = LatencyStats()
+        # elastic lifecycle (engine/artifact.py): engine-construction wall
+        # time per load_model, and whether each artifact-configured load
+        # actually cold-started from its artifact (hit) or fell back to
+        # from-scratch init (miss) — the respawn-latency receipts
+        self.model_load_stats = LatencyStats()
+        self._last_load_s: Dict[str, float] = {}
+        self._artifact_hits = 0
+        self._artifact_misses = 0
         self._methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]] = {
             "ping": self._rpc_ping,
             "generate": self._rpc_generate,
@@ -323,10 +331,22 @@ class WorkerServer(FramedServerMixin):
             return
         t0 = time.perf_counter()
         engine = self.engine_factory(cfg)
+        artifact_hit = getattr(engine, "artifact_manifest", None) is not None
+        if cfg.metadata.get("artifact"):
+            if artifact_hit:
+                self._artifact_hits += 1
+            else:
+                self._artifact_misses += 1
         if cfg.metadata.get("warmup") and hasattr(engine, "warmup"):
             # pre-compile the serving programs at load time so the first
-            # real request doesn't pay the XLA compile (metadata warmup=1)
-            n = engine.warmup()
+            # real request doesn't pay the XLA compile (metadata warmup=1).
+            # An artifact cold-start warms only the bucket shapes its
+            # writer recorded — the respawn path compiles what the dead
+            # worker actually served, not the full grid.
+            if artifact_hit and hasattr(engine, "warmup_from_manifest"):
+                n = engine.warmup_from_manifest()
+            else:
+                n = engine.warmup()
             logger.info("worker %s warmed %s (%d rounds)",
                         self.worker_id, cfg.name, n)
         self.engines[cfg.name] = engine
@@ -339,9 +359,12 @@ class WorkerServer(FramedServerMixin):
                 engine,
                 mixed_step_tokens=(
                     int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
-        logger.info("worker %s loaded model %s (%s) in %.2fs",
-                    self.worker_id, cfg.name, cfg.architecture,
-                    time.perf_counter() - t0)
+        load_s = time.perf_counter() - t0
+        self.model_load_stats.add(load_s)
+        self._last_load_s[cfg.name] = load_s
+        logger.info("worker %s loaded model %s (%s) in %.2fs%s",
+                    self.worker_id, cfg.name, cfg.architecture, load_s,
+                    " [artifact cold-start]" if artifact_hit else "")
 
     async def load_model_async(self, cfg: ModelConfig) -> None:
         """Load off the event loop, on the single engine thread — serializes
@@ -893,7 +916,10 @@ class WorkerServer(FramedServerMixin):
     async def _rpc_load_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         cfg = ModelConfig.from_dict(msg["config"])
         await self.load_model_async(cfg)
-        return {"loaded": cfg.name}
+        return {"loaded": cfg.name,
+                # measured engine-construction wall time (idempotent
+                # re-loads report the original) — demo/supervisor receipts
+                "load_s": self._last_load_s.get(cfg.name, 0.0)}
 
     async def _rpc_unload_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return {"unloaded": self.unload_model(msg["model"])}
@@ -994,6 +1020,9 @@ class WorkerServer(FramedServerMixin):
             "ping_count": self._ping_count,          # probes counted apart
             "active_connections": self._active_connections,
             "latency": self.latency.snapshot(),
+            "model_load": self.model_load_stats.snapshot(),
+            "artifact_hits": self._artifact_hits,
+            "artifact_misses": self._artifact_misses,
             "models": {name: eng.get_metrics()
                        for name, eng in self.engines.items()},
             # pump stats without the engine sub-dict ("models" above

@@ -1,0 +1,296 @@
+"""Pre-fused serving artifacts: seconds-scale worker cold start.
+
+Engine init pays for its weights three times — load/init, quantize, then
+``ops.quant.prepare_params`` (kernel-mode resolve + qkv/gate-up fusion +
+lm-head pad). On an 8B model that is minutes of wall clock, which voids
+the control plane's failover story: a respawned worker is "replaced"
+3.5 minutes later. An artifact freezes the *post*-prepare tree once, so
+every subsequent boot is an Orbax restore plus a self-check instead of a
+re-derivation.
+
+Layout (one directory per model):
+
+    <path>/spec.json       ModelSpec sidecar   (utils/checkpoint.py)
+    <path>/params/         Orbax PyTree of the PREPARED tree — fused
+                           payloads, padded lm head, QuantizedTensor
+                           nodes bit-exact through the int4 round trip
+    <path>/manifest.json   commit point, written LAST via atomic
+                           tmp+rename (utils/files.py)
+
+Crash consistency is the manifest-last protocol: ``save_artifact`` writes
+params first and publishes the manifest only after everything else is on
+disk, so a crash mid-save leaves a manifest-less directory that
+``has_artifact`` treats as absent — a respawning worker can never trust a
+half-written tree. Rewrites delete the old manifest *first* for the same
+reason: a stale manifest must not vouch for params mid-replacement.
+
+Trust, but verify (three layers, cheapest first):
+
+1. **Feature hash** — sha256 of the deploy config's identity fields. A
+   config drift (dtype flip, different quant bits, other checkpoint)
+   raises ``ArtifactMismatchError`` before any bytes are read.
+2. **Tree checksum** — sha256 over every leaf's path/dtype/shape/bytes.
+   Truncated files, flipped bits, or an Orbax restore error raise
+   ``ArtifactCorruptError``.
+3. **Golden-token probe** — the manifest records a tiny greedy generation
+   captured at save time; the engine re-runs it before admitting traffic.
+   This is the end-to-end check the checksum cannot give (it exercises
+   the actual compiled programs against the restored tree) and doubles as
+   a bb=1 warmup. Mismatch ⇒ ``ArtifactCorruptError`` ⇒ the factory falls
+   back to the slow path — wrong numerics are never served.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import pathlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import checkpoint
+from ..utils.files import atomic_write_json
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_FILE = "manifest.json"
+ARTIFACT_VERSION = 1
+# the probe prompt is arbitrary but FIXED: it must replay bit-identically
+# at load time, and ids this small exist in every real vocabulary
+GOLDEN_PROMPT: Tuple[int, ...] = (1, 2, 3, 5, 8, 13, 21)
+GOLDEN_MAX_NEW = 8
+
+
+class ArtifactError(RuntimeError):
+    """Base for artifact load/validation failures (factory catches this
+    to fall back to the slow path)."""
+
+
+class ArtifactCorruptError(ArtifactError):
+    """The artifact's bytes or numerics are wrong: unreadable manifest,
+    checksum mismatch, failed Orbax restore, or golden-probe divergence."""
+
+
+class ArtifactMismatchError(ArtifactError):
+    """The artifact is internally consistent but was built for a
+    different deploy config (feature hash differs)."""
+
+
+# -------------------------------------------------------------- hashing
+
+def feature_hash(cfg) -> str:
+    """sha256 of the ``ModelConfig`` fields that change the prepared
+    tree. Engine *runtime* knobs (buckets, page sizes, batcher limits)
+    deliberately stay out: the same artifact serves any of them."""
+    ident = {
+        "architecture": cfg.architecture,
+        "path": cfg.path or "",
+        "dtype": cfg.dtype or "",
+        "max_seq_len": int(cfg.max_seq_len),
+        "quantized": bool(cfg.quantized),
+        "weight_bits": int(cfg.metadata.get("weight_bits", 8)),
+        "size": str(cfg.metadata.get("size", "")),
+        "seed": int(cfg.metadata.get("seed", 0)),
+    }
+    blob = json.dumps(ident, sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def tree_checksum(params: Any) -> str:
+    """sha256 over every leaf's (path, dtype, shape, bytes), leaves
+    sorted by path so the digest is traversal-order independent.
+    QuantizedTensor nodes are registered pytrees — their q/s arrays (and
+    therefore the int4 packing) are covered leaf-by-leaf."""
+    import jax
+    import numpy as np
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    items = sorted(((jax.tree_util.keystr(path), leaf)
+                    for path, leaf in leaves), key=lambda kv: kv[0])
+    h = hashlib.sha256()
+    for key, leaf in items:
+        arr = np.asarray(leaf)
+        h.update(key.encode("utf-8"))
+        h.update(str(arr.dtype).encode("utf-8"))
+        h.update(str(arr.shape).encode("utf-8"))
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def quant_summary(params: Any) -> Dict[str, int]:
+    """``{"int4": n, "int8": m}`` count of QuantizedTensor nodes by bit
+    width — recorded in the manifest so an operator can read what mode an
+    artifact holds without restoring it."""
+    from ..ops.quant import QuantizedTensor
+
+    out: Dict[str, int] = {}
+
+    def walk(node: Any) -> None:
+        if isinstance(node, QuantizedTensor):
+            key = f"int{node.bits}"
+            out[key] = out.get(key, 0) + 1
+        elif isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+
+    walk(params)
+    return out
+
+
+# ------------------------------------------------------------- manifest
+
+def _manifest_path(path: str) -> pathlib.Path:
+    return pathlib.Path(path).absolute() / MANIFEST_FILE
+
+
+def has_artifact(path: str) -> bool:
+    """True iff ``path`` holds a COMMITTED artifact — the manifest is
+    written last, so its presence is the commit point."""
+    return _manifest_path(path).is_file()
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> str:
+    return atomic_write_json(str(_manifest_path(path)), manifest)
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    p = _manifest_path(path)
+    try:
+        manifest = json.loads(p.read_text())
+    except FileNotFoundError:
+        raise ArtifactCorruptError(
+            f"no artifact manifest at {p} (absent or uncommitted save)")
+    except (OSError, ValueError) as e:
+        raise ArtifactCorruptError(
+            f"artifact manifest {p} unreadable ({e})") from e
+    if not isinstance(manifest, dict):
+        raise ArtifactCorruptError(f"artifact manifest {p} is not an object")
+    version = manifest.get("version")
+    if version != ARTIFACT_VERSION:
+        raise ArtifactCorruptError(
+            f"artifact manifest {p} has version {version!r}; this build "
+            f"reads version {ARTIFACT_VERSION}")
+    missing = [k for k in ("checksum", "feature_hash") if k not in manifest]
+    if missing:
+        raise ArtifactCorruptError(
+            f"artifact manifest {p} is missing fields {missing}")
+    return manifest
+
+
+# ----------------------------------------------------------- save / load
+
+def save_artifact(path: str, spec, params: Any, cfg=None,
+                  buckets: Optional[Dict[str, List[int]]] = None,
+                  engine=None,
+                  golden_prompt: Optional[List[int]] = None,
+                  golden_max_new: int = GOLDEN_MAX_NEW) -> str:
+    """Persist a PREPARED param tree (+ spec sidecar + manifest).
+
+    ``params`` must be the post-``prepare_params`` tree — that is the
+    entire point of the artifact; loading skips preparation. ``engine``
+    (optional) records a golden-token probe by running a tiny greedy
+    generation NOW, at save time, on the very tree being persisted; a
+    loader replays it before admitting traffic. Returns ``path``."""
+    p = pathlib.Path(path).absolute()
+    stale = p / MANIFEST_FILE
+    if stale.exists():
+        # rewrite: retract the commit point FIRST so the old manifest
+        # cannot vouch for half-replaced params if we crash below
+        stale.unlink()
+    checkpoint.save_params(str(p), spec, params)
+    manifest: Dict[str, Any] = {
+        "version": ARTIFACT_VERSION,
+        "feature_hash": feature_hash(cfg) if cfg is not None else "",
+        "checksum": tree_checksum(params),
+        "quant": quant_summary(params),
+        "buckets": dict(buckets or {}),
+        "golden": None,
+    }
+    if engine is not None:
+        prompt = [int(t) for t in (golden_prompt or GOLDEN_PROMPT)]
+        tokens = run_probe(engine, prompt, golden_max_new)
+        manifest["golden"] = {"prompt": prompt,
+                              "max_new_tokens": int(golden_max_new),
+                              "tokens": tokens}
+    write_manifest(str(p), manifest)
+    logger.info("serving artifact committed at %s (quant=%s, golden=%s)",
+                p, manifest["quant"] or "none",
+                "yes" if manifest["golden"] else "no")
+    return str(p)
+
+
+def load_artifact(path: str, cfg=None,
+                  template: Optional[Any] = None) -> Tuple[Any, Any, Dict]:
+    """Restore ``(spec, params, manifest)`` from a committed artifact.
+
+    Raises ``ArtifactMismatchError`` when ``cfg`` is given and its
+    feature hash differs from the manifest's (cheap, before any restore),
+    and ``ArtifactCorruptError`` for unreadable/truncated/bit-flipped
+    params — any Orbax failure is wrapped, so callers need exactly one
+    except clause to fall back to the slow path."""
+    manifest = load_manifest(path)
+    if cfg is not None and manifest["feature_hash"]:
+        want = feature_hash(cfg)
+        if want != manifest["feature_hash"]:
+            raise ArtifactMismatchError(
+                f"artifact {path} was built for a different config "
+                f"(feature hash {manifest['feature_hash'][:12]}… != "
+                f"{want[:12]}…) — refusing to serve it")
+    try:
+        spec = checkpoint.load_spec(path)
+        params = checkpoint.load_params(path, template=template)
+    except ArtifactError:
+        raise
+    except Exception as e:
+        raise ArtifactCorruptError(
+            f"artifact {path} failed to restore ({type(e).__name__}: "
+            f"{e})") from e
+    got = tree_checksum(params)
+    if got != manifest["checksum"]:
+        raise ArtifactCorruptError(
+            f"artifact {path} checksum mismatch (manifest "
+            f"{manifest['checksum'][:12]}…, restored {got[:12]}…) — "
+            "params are corrupt")
+    return spec, params, manifest
+
+
+# ---------------------------------------------------------- golden probe
+
+def run_probe(engine, prompt: List[int], max_new: int) -> List[int]:
+    """One tiny greedy generation on ``engine``, returned as plain ints.
+    Handles both engine interfaces: batch ``generate`` (static engine)
+    and ``submit`` + ``run_until_idle`` (continuous)."""
+    from .types import GenerationRequest
+
+    req = GenerationRequest(prompt=[int(t) for t in prompt],
+                            max_new_tokens=int(max_new),
+                            temperature=0.0,
+                            request_id="__artifact_probe__")
+    if hasattr(engine, "generate"):
+        result = engine.generate([req])[0]
+        return [int(t) for t in result.tokens]
+    rid = engine.submit(req)
+    for r in engine.run_until_idle():
+        if r.request_id == rid:
+            return [int(t) for t in r.tokens]
+    raise ArtifactCorruptError(
+        "golden probe vanished: continuous engine never finished it")
+
+
+def verify_golden(engine, manifest: Optional[Dict[str, Any]]) -> bool:
+    """Replay the manifest's golden probe on ``engine``; True when it ran
+    and matched, False when the manifest records none. Divergence raises
+    ``ArtifactCorruptError`` — the caller must NOT admit traffic."""
+    golden = (manifest or {}).get("golden")
+    if not golden:
+        return False
+    want = [int(t) for t in golden["tokens"]]
+    got = run_probe(engine, golden["prompt"], golden["max_new_tokens"])
+    if got != want:
+        raise ArtifactCorruptError(
+            f"golden-token self-check FAILED: expected {want}, got {got} "
+            "— artifact numerics are wrong, refusing to serve")
+    return True

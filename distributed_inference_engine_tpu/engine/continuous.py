@@ -167,15 +167,31 @@ class ContinuousEngine:
                             # (long prompts stall decode 1/sp as long, the
                             # same concern prefill_chunk addresses in time
                             # rather than space — the two are exclusive)
+        artifact_path=None,       # pre-fused serving artifact
+                            # (engine/artifact.py): restore the prepared
+                            # tree instead of init/quantize/fuse/pad; spec
+                            # may be None (the sidecar is authoritative)
+        artifact_selfcheck=True,  # replay the golden-token probe before
+                            # admitting traffic (mismatch raises
+                            # ArtifactCorruptError, never serves wrong
+                            # numerics)
     ) -> None:
-        self.spec = spec.validate()
         self.config = config or EngineConfig()
         cfg = self.config
         if cfg.decode_mode not in ("window", "inline"):
-            # before param init: a typo'd mode must not pay an 8B-scale
-            # random init first
+            # before param init/artifact restore: a typo'd mode must not
+            # pay an 8B-scale random init first
             raise ValueError(
                 f"decode_mode {cfg.decode_mode!r} is not 'window'|'inline'")
+        self.artifact_manifest: Optional[Dict[str, Any]] = None
+        if artifact_path is not None:
+            from .artifact import load_artifact
+
+            a_spec, params, self.artifact_manifest = load_artifact(
+                artifact_path)
+            if spec is None:
+                spec = a_spec
+        self.spec = spec.validate()
         # defer_sync needs a fully backed pool: host lengths go one chunk
         # stale, and only a pool that can always grow every slot to
         # max_seq_len guarantees a chunk never writes past reserved pages.
@@ -193,11 +209,16 @@ class ContinuousEngine:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
             params = shard_fn(params)
-        from ..ops.quant import prepare_params
+        if self.artifact_manifest is not None:
+            # the artifact IS the post-prepare tree — re-preparing would
+            # re-pay the fuse/pad cost the fast path exists to skip
+            self.params = params
+        else:
+            from ..ops.quant import prepare_params
 
-        # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
-        # payload fusion, shared across engines (ops.quant.prepare_params)
-        self.params = prepare_params(params)
+            # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
+            # payload fusion, shared across engines (ops.quant.prepare_params)
+            self.params = prepare_params(params)
         self._rng = jax.random.key(seed + 1)
 
         self.max_slots = cfg.max_slots
@@ -797,6 +818,16 @@ class ContinuousEngine:
         # gap between steps. The hook must only enqueue (engine.submit);
         # it must NOT call step()/install paths.
         self.overlap_hook: Optional[Any] = None
+
+        if self.artifact_manifest is not None and artifact_selfcheck:
+            # golden-token self-check BEFORE any traffic: replays the
+            # save-time probe against the restored tree through the real
+            # admission/decode programs (also a bb=1 warmup). Raises
+            # ArtifactCorruptError on divergence — callers fall back to
+            # the slow path rather than serve wrong numerics.
+            from .artifact import verify_golden
+
+            verify_golden(self, self.artifact_manifest)
 
     # ------------------------------------------------------------- submit
 
@@ -2296,6 +2327,20 @@ class ContinuousEngine:
             self.prefix_cache = saved_prefix
             self.config.max_waiting = saved_cap
         return runs
+
+    def warmup_from_manifest(self, max_new_tokens: int = 2) -> int:
+        """Artifact-aware warmup: prime only the admission batch buckets
+        the artifact's writer recorded, so a respawned worker warms what
+        its predecessor actually served instead of the full bucket grid.
+        Falls back to the full ``warmup`` when the manifest records
+        nothing usable (absent, or config drifted)."""
+        valid = set(_pow2_buckets(self.max_slots))
+        b = (self.artifact_manifest or {}).get("buckets", {})
+        batches = [n for n in b.get("batch", []) if n in valid]
+        if not batches:
+            return self.warmup(max_new_tokens=max_new_tokens)
+        return sum(self.warmup(batch=n, max_new_tokens=max_new_tokens)
+                   for n in batches)
 
     # ------------------------------------------------------------ metrics
 

@@ -108,18 +108,39 @@ class Engine:
                          # context-parallel against a sequence-sharded KV
                          # cache (greedy near-ties may resolve differently
                          # than unsharded: reordered fp reductions)
+        artifact_path: Optional[str] = None,   # pre-fused serving artifact
+                         # (engine/artifact.py): restore the prepared tree
+                         # instead of init/quantize/fuse/pad; spec may be
+                         # None (the artifact's sidecar is authoritative)
+        artifact_selfcheck: bool = True,       # replay the golden-token
+                         # probe before admitting traffic (mismatch raises
+                         # ArtifactCorruptError — never serve wrong numerics)
     ) -> None:
+        self.artifact_manifest: Optional[Dict[str, Any]] = None
+        if artifact_path is not None:
+            from .artifact import load_artifact
+
+            a_spec, params, self.artifact_manifest = load_artifact(
+                artifact_path)
+            if spec is None:
+                spec = a_spec
         self.spec = spec.validate()
         self.config = config or EngineConfig()
         if params is None:
             params = init_params(spec, jax.random.key(seed))
         if shard_fn is not None:
             params = shard_fn(params)
-        from ..ops.quant import prepare_params
+        if self.artifact_manifest is not None:
+            # the artifact IS the post-prepare tree — re-preparing would
+            # re-pay the fuse/pad cost the fast path exists to skip
+            # (prepare_params is idempotent, but not free)
+            self.params = params
+        else:
+            from ..ops.quant import prepare_params
 
-        # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
-        # payload fusion, shared across engines (ops.quant.prepare_params)
-        self.params = prepare_params(params)
+            # kernel-mode selection (sharded int4 -> "cp") + qkv/gate+up
+            # payload fusion, shared across engines (ops.quant.prepare_params)
+            self.params = prepare_params(params)
         self._rng = jax.random.key(seed + 1)
 
         # context-parallel decode: with an sp mesh the dense KV cache is
@@ -227,6 +248,16 @@ class Engine:
         self._total_prompt_tokens = 0
         self._total_generated_tokens = 0
         self._total_errors = 0
+
+        if self.artifact_manifest is not None and artifact_selfcheck:
+            # golden-token self-check BEFORE any traffic: replays the
+            # save-time probe against the restored tree through the real
+            # compiled programs (also a bb=1 warmup). Raises
+            # ArtifactCorruptError on divergence — the factory falls back
+            # to the slow path rather than serve wrong numerics.
+            from .artifact import verify_golden
+
+            verify_golden(self, self.artifact_manifest)
 
     # ------------------------------------------------------------ generate
 
@@ -431,6 +462,19 @@ class Engine:
                 ])
                 runs += 1
         return runs
+
+    def warmup_from_manifest(self, max_new_tokens: int = 2) -> int:
+        """Artifact-aware warmup: compile only the batch buckets the
+        artifact's writer recorded as its serving shapes, so a respawned
+        worker warms what its predecessor actually served instead of the
+        full bucket grid. Falls back to the full ``warmup`` when the
+        manifest records nothing usable (absent, or config drifted)."""
+        b = (self.artifact_manifest or {}).get("buckets", {})
+        batches = [n for n in b.get("batch", []) if n in self.batch_buckets]
+        if not batches:
+            return self.warmup(max_new_tokens=max_new_tokens)
+        return sum(self.warmup(batch=n, max_new_tokens=max_new_tokens)
+                   for n in batches)
 
     # ------------------------------------------------------------- metrics
 

@@ -1,3 +1,5 @@
+import logging
+
 from .base import (  # noqa: F401
     ModelSpec,
     init_params,
@@ -14,6 +16,8 @@ from .qwen import qwen_spec  # noqa: F401
 from .mistral import mistral_spec  # noqa: F401
 from .gemma import gemma_spec  # noqa: F401
 from .fake import FakeContinuousEngine, FakeEngine  # noqa: F401
+
+logger = logging.getLogger(__name__)
 
 # family prefix -> (spec factory, default size). Sizes live in each family
 # module; architecture strings like "qwen2-7b" select the size directly.
@@ -129,6 +133,77 @@ def engine_from_config(cfg):
             raise ValueError(
                 f"deploy requests mesh dp={dp} sp={sp} tp={tp} "
                 f"({need} devices) but only {len(devs)} are visible")
+    ecfg = EngineConfig(max_slots=cfg.max_batch_size,
+                        max_seq_len=cfg.max_seq_len)
+    for k in ("page_size", "num_pages", "decode_steps_per_call",
+              "attention_impl", "kv_dtype", "prefill_buckets",
+              "prefix_cache", "prefill_chunk", "decode_mode",
+              "max_waiting", "queue_deadline_s",
+              "kv_offload", "kv_offload_bytes", "mixed_step_tokens"):
+        if k in cfg.metadata:
+            setattr(ecfg, k, cfg.metadata[k])
+
+    # ---- pre-fused serving artifact (engine/artifact.py): the elastic
+    # fast path. metadata artifact=<dir> restores the post-quantize/fuse/
+    # pad tree — skipping the minutes-scale init a respawned worker would
+    # otherwise re-pay — and the golden-token self-check gates admission.
+    # Single-host Engine/ContinuousEngine only: mesh deploys re-resolve
+    # kernel modes against the sharding, and the speculative/prefill
+    # engines carry extra state the artifact does not capture.
+    spec_k = int(cfg.metadata.get("speculative", 0))
+    art = str(cfg.metadata.get("artifact", "") or "")
+    art_required = bool(int(cfg.metadata.get("artifact_required", 0) or 0))
+    art_selfcheck = bool(int(cfg.metadata.get("artifact_selfcheck", 1)))
+    art_eligible = (bool(art) and not want_mesh and not spec_k
+                    and cfg.metadata.get("role") != "prefill")
+    if art and not art_eligible:
+        if art_required:
+            raise ValueError(
+                "artifact_required is set but this deploy is not "
+                "artifact-eligible: mesh/speculative/prefill engines "
+                "cannot cold-start from a serving artifact")
+        logger.warning(
+            "artifact metadata ignored for model %s: only single-host "
+            "Engine/ContinuousEngine deploys cold-start from artifacts",
+            cfg.name)
+    if art_eligible:
+        from ..engine.artifact import (
+            ArtifactCorruptError,
+            ArtifactError,
+            ArtifactMismatchError,
+            feature_hash,
+            has_artifact,
+            load_manifest,
+        )
+
+        if has_artifact(art):
+            try:
+                manifest = load_manifest(art)
+                if (manifest["feature_hash"]
+                        and manifest["feature_hash"] != feature_hash(cfg)):
+                    raise ArtifactMismatchError(
+                        f"artifact {art} was built for a different deploy "
+                        "config (feature hash differs) — refusing to "
+                        "serve it")
+                if cfg.metadata.get("continuous"):
+                    from ..engine.continuous import ContinuousEngine
+
+                    return ContinuousEngine(
+                        None, config=ecfg, artifact_path=art,
+                        artifact_selfcheck=art_selfcheck)
+                return Engine(None, config=ecfg, artifact_path=art,
+                              artifact_selfcheck=art_selfcheck)
+            except ArtifactError as e:
+                if art_required:
+                    raise
+                logger.warning(
+                    "artifact %s rejected (%s: %s) — falling back to "
+                    "from-scratch init and rewriting it", art,
+                    type(e).__name__, e)
+        elif art_required:
+            raise ArtifactCorruptError(
+                f"artifact_required is set but no committed artifact "
+                f"exists at {art}")
     from ..utils.checkpoint import is_native_checkpoint, load_params, load_spec
 
     built = None                       # (mesh, ModelShardings) once built
@@ -197,16 +272,6 @@ def engine_from_config(cfg):
                 bits=bits)
         else:
             params = quantize_params(spec, params, bits=bits)
-    ecfg = EngineConfig(max_slots=cfg.max_batch_size,
-                        max_seq_len=cfg.max_seq_len)
-    for k in ("page_size", "num_pages", "decode_steps_per_call",
-              "attention_impl", "kv_dtype", "prefill_buckets",
-              "prefix_cache", "prefill_chunk", "decode_mode",
-              "max_waiting", "queue_deadline_s",
-              "kv_offload", "kv_offload_bytes", "mixed_step_tokens"):
-        if k in cfg.metadata:
-            setattr(ecfg, k, cfg.metadata[k])
-
     # config-driven parallel serving: build the mesh + shardings from the
     # validated metadata so a plain deploy config (CLI flag, coordinator
     # deploy_model, config file) can request tensor-/sequence-parallel
@@ -222,7 +287,6 @@ def engine_from_config(cfg):
         kv_sharding = shardings.paged_kv
         if sp > 1:
             sp_mesh = mesh
-    spec_k = int(cfg.metadata.get("speculative", 0))
     if spec_k:
         # draft-model speculative decoding (engine/speculative.py):
         # metadata speculative=K, draft_size=<spec name>, optional
@@ -268,8 +332,39 @@ def engine_from_config(cfg):
     if cfg.metadata.get("continuous"):
         from ..engine.continuous import ContinuousEngine
 
-        return ContinuousEngine(spec, params=params, config=ecfg,
-                                shard_fn=shard_fn, kv_sharding=kv_sharding,
-                                sp_mesh=sp_mesh)
-    return Engine(spec, params=params, config=ecfg, shard_fn=shard_fn,
-                  sp_mesh=sp_mesh)
+        eng = ContinuousEngine(spec, params=params, config=ecfg,
+                               shard_fn=shard_fn, kv_sharding=kv_sharding,
+                               sp_mesh=sp_mesh)
+    else:
+        eng = Engine(spec, params=params, config=ecfg, shard_fn=shard_fn,
+                     sp_mesh=sp_mesh)
+    if art_eligible:
+        # elastic flow: the first (slow) boot commits the prepared tree so
+        # every subsequent respawn cold-starts from it in seconds
+        _refresh_artifact(art, cfg, eng, probe=art_selfcheck)
+    return eng
+
+
+def _refresh_artifact(path: str, cfg, engine, probe: bool = True) -> None:
+    """Best-effort artifact (re)write after a slow-path init. Failure is
+    logged, never fatal — the engine just built is healthy regardless; the
+    next boot simply pays the slow path again."""
+    from ..engine.artifact import save_artifact
+    from ..engine.engine import _pow2_buckets
+
+    try:
+        buckets = {
+            "batch": [int(x) for x in
+                      (getattr(engine, "batch_buckets", None)
+                       or _pow2_buckets(engine.max_slots))],
+            "prefill": [int(x) for x in
+                        getattr(engine, "prefill_buckets", [])],
+            "seq": [int(x) for x in getattr(engine, "seq_buckets", [])],
+        }
+        save_artifact(path, engine.spec, engine.params, cfg=cfg,
+                      buckets=buckets, engine=engine if probe else None)
+    # graftlint: ok[swallowed-transport-error] local best-effort persistence, no peer involved; the slow-path engine serves either way
+    except Exception:
+        logger.exception(
+            "serving-artifact write to %s failed — serving from the "
+            "slow-path engine anyway", path)

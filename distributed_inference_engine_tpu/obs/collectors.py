@@ -262,6 +262,10 @@ COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
      "Requests answered with the typed deadline outcome"),
     ("drains", "coordinator_drains", "c",
      "Graceful worker drains completed"),
+    ("supervisor_respawns", "supervisor_respawns", "c",
+     "Unhealthy workers respawned and re-admitted by the supervisor"),
+    ("supervisor_crashloop_opens", "supervisor_crashloop_opens", "c",
+     "Crash-loop breakers opened (worker given up on, shards FAILED)"),
 ]
 
 WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
@@ -283,8 +287,14 @@ WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
     ("ping_count", "worker_pings", "c", "Health probes answered"),
     ("active_connections", "worker_active_connections", "g",
      "Open RPC connections"),
+    ("artifact_hits", "worker_artifact_hits", "c",
+     "Model loads cold-started from a pre-fused serving artifact"),
+    ("artifact_misses", "worker_artifact_misses", "c",
+     "Artifact-configured loads that fell back to the slow path"),
     ("latency", "worker_request_seconds", "h",
      "generate/generate_stream RPC wall time"),
+    ("model_load", "worker_model_load_seconds", "h",
+     "load_model wall time (artifact cold-start vs slow path)"),
 ]
 
 # families whose label values are dynamic (declared here so the catalog

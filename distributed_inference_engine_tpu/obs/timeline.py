@@ -105,9 +105,11 @@ class StepTimeline:
 
     def dump(self, path: str,
              events: Optional[List[Dict[str, Any]]] = None) -> str:
-        with open(path, "w") as f:
-            json.dump(self.to_chrome_trace(events), f)
-        return path
+        # tmp+rename so a crash mid-dump never leaves Perfetto a half-JSON
+        from ..utils.files import atomic_write
+
+        trace = self.to_chrome_trace(events)
+        return atomic_write(path, lambda f: json.dump(trace, f))
 
 
 def busy_gap_split(events: List[Dict[str, Any]]) -> Dict[str, float]:

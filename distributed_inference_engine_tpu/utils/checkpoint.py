@@ -23,6 +23,8 @@ import json
 import pathlib
 from typing import Any, Dict, Optional
 
+from .files import atomic_write_json
+
 SPEC_FILE = "spec.json"
 PARAMS_DIR = "params"
 _QUANT_MARKER = "__quantized_tensor__"
@@ -104,7 +106,9 @@ def save_params(path: str, spec, params: Any) -> str:
 
     p = pathlib.Path(path).absolute()
     p.mkdir(parents=True, exist_ok=True)
-    (p / SPEC_FILE).write_text(json.dumps(spec.to_dict(), indent=2))
+    # atomic: a crash mid-save must not leave a torn spec sidecar that
+    # poisons the next load_spec
+    atomic_write_json(str(p / SPEC_FILE), spec.to_dict())
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(p / PARAMS_DIR, _encode_tree(params), force=True)
     ckptr.close()
@@ -157,7 +161,7 @@ def save_train_state(path: str, spec, state: Dict[str, Any]) -> str:
 
     p = pathlib.Path(path).absolute()
     p.mkdir(parents=True, exist_ok=True)
-    (p / SPEC_FILE).write_text(json.dumps(spec.to_dict(), indent=2))
+    atomic_write_json(str(p / SPEC_FILE), spec.to_dict())
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(p / "state", _encode_tree(state), force=True)
     ckptr.close()

@@ -10,9 +10,10 @@ snapshots.
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
-from typing import Callable, IO
+from typing import Any, Callable, IO
 
 
 def atomic_write(path: str, write_fn: Callable[[IO], None],
@@ -35,3 +36,10 @@ def atomic_write(path: str, write_fn: Callable[[IO], None],
             pass
         raise
     return path
+
+
+def atomic_write_json(path: str, obj: Any, indent: int = 2) -> str:
+    """JSON convenience over ``atomic_write``: readers either see the
+    previous document or the complete new one, never a truncated parse."""
+    return atomic_write(
+        path, lambda f: json.dump(obj, f, indent=indent, sort_keys=True))

@@ -30,7 +30,10 @@ from distributed_inference_engine_tpu.api.coordinator import (  # noqa: E402
 )
 from distributed_inference_engine_tpu.cluster.worker import WorkerServer  # noqa: E402
 from distributed_inference_engine_tpu.config import (  # noqa: E402
-    ModelConfig, ServerConfig,
+    HealthConfig, ModelConfig, ServerConfig,
+)
+from distributed_inference_engine_tpu.engine.artifact import (  # noqa: E402
+    ARTIFACT_VERSION, load_manifest, write_manifest,
 )
 from distributed_inference_engine_tpu.models.fake import _chain  # noqa: E402
 from distributed_inference_engine_tpu.utils.faults import (  # noqa: E402
@@ -144,6 +147,122 @@ async def chaos_run(n_workers, n_requests, seed, rate):
     return ok, dupes
 
 
+async def supervisor_run(n_workers, n_requests, seed, rate):
+    """The elastic leg: nobody hand-respawns the killed worker this time —
+    the coordinator's SUPERVISOR notices the corpse via the health loop,
+    calls the restart hook (which gates on the serving artifact's
+    manifest, the same check a real artifact cold-start makes), and
+    re-admits the replacement half-open. Then the artifact is garbled and
+    a second worker killed: every respawn attempt now fails the manifest
+    gate, the crash-loop breaker opens, and the survivors keep serving."""
+    import tempfile
+
+    art = tempfile.mkdtemp(prefix="fleet_art_")
+    # a committed (if weightless) manifest: the fake engines don't read
+    # params, so the manifest alone stands in for the artifact here
+    write_manifest(art, {"version": ARTIFACT_VERSION, "feature_hash": "",
+                         "checksum": "", "quant": {}, "buckets": {},
+                         "golden": None})
+    plan = FaultPlan(seed=seed, specs=default_menu(
+        rate=rate, delay_s=0.005, verbs=("generate",)))
+    coord = Coordinator(CoordinatorConfig(
+        retry_seed=seed, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1, supervisor_crashloop_threshold=3,
+        supervisor_crashloop_window_s=30.0))
+    spawned = []
+
+    async def restart_hook(worker_id, info):
+        load_manifest(art)              # corrupt artifact -> failed respawn
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        w.fault_plan = plan
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+
+    coord.start_supervisor(restart_hook)
+    await coord.start()
+    cfg = ModelConfig(name="m", architecture="fake", metadata={
+        "continuous": 1, "max_slots": 4, "step_latency_s": 0.005})
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        w.fault_plan = plan
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    await coord.deploy_model(cfg)
+
+    print(f"=== supervisor run: {n_workers} workers, {n_requests} "
+          f"requests, seed={seed}, fault rate={rate} ===")
+    prompts = [[300 + i, i % 5, 7] for i in range(n_requests)]
+    tasks = [asyncio.ensure_future(
+        coord.submit("m", prompt=p, max_new_tokens=8, request_id=f"s{i}"))
+        for i, p in enumerate(prompts)]
+
+    await asyncio.sleep(0.1)
+    victim = f"w{n_workers - 1}"
+    print(f"  !! hard-killing {victim} — NO manual respawn this time")
+    await workers.pop(victim).stop()
+
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    ok, ids = 0, set()
+    for p, r in zip(prompts, results):
+        if isinstance(r, dict) and r["tokens"] == expected_tokens(p, 8):
+            ok += 1
+            ids.add(r["request_id"])
+    dupes = ok - len(ids)
+
+    # the supervisor may still be mid-respawn when the load finishes
+    for _ in range(200):
+        if coord.get_stats()["supervisor_respawns"] >= 1:
+            break
+        await asyncio.sleep(0.05)
+    stats = coord.get_stats()
+    respawns = stats["supervisor_respawns"]
+    print(f"  completion {ok}/{n_requests} "
+          f"({100.0 * ok / n_requests:.1f}%), {dupes} duplicates")
+    print(f"  supervisor: respawns={respawns} (auto, artifact-gated), "
+          f"{victim} back in rotation={victim in coord.router.workers}")
+
+    print("  !! garbling the serving artifact, then killing w0")
+    with open(os.path.join(art, "manifest.json"), "w") as f:
+        f.write("{")                    # torn write: unreadable manifest
+    await workers.pop("w0").stop()
+    for _ in range(400):
+        if coord.get_stats()["supervisor_crashloop_opens"] >= 1:
+            break
+        await asyncio.sleep(0.05)
+    stats = coord.get_stats()
+    opens = stats["supervisor_crashloop_opens"]
+    degraded = stats["supervisor"]["degraded_workers"]
+    print(f"  crash-loop breaker opens={opens}, degraded={degraded}")
+
+    # the degraded worker is out of both planes; survivors still serve
+    tail_prompts = [[900 + i, 2] for i in range(8)]
+    tail = await asyncio.gather(
+        *[coord.submit("m", prompt=p, max_new_tokens=6)
+          for p in tail_prompts], return_exceptions=True)
+    tail_ok = sum(1 for p, r in zip(tail_prompts, tail)
+                  if isinstance(r, dict)
+                  and r["tokens"] == expected_tokens(p, 6))
+    print(f"  survivors after breaker open: {tail_ok}/8 token-exact")
+
+    await stop_fleet(coord, workers)
+    for w in spawned:
+        try:
+            await w.stop()
+        except Exception:
+            pass
+    healthy = (ok >= 0.99 * n_requests and dupes == 0 and respawns >= 1
+               and opens == 1 and tail_ok == 8)
+    return healthy
+
+
 async def replay_run(seed, n=16):
     """Sequential fixed-key load: the call pattern — and therefore the
     fault sequence — is a pure function of the seed."""
@@ -180,6 +299,8 @@ async def replay_run(seed, n=16):
 async def main_async(args):
     ok, dupes = await chaos_run(args.workers, args.requests, args.seed,
                                 args.rate)
+    supervised_ok = await supervisor_run(args.workers, args.requests,
+                                         args.seed, args.rate)
     print("=== reproducibility: two sequential runs, same seed ===")
     seq_a, out_a = await replay_run(args.seed)
     seq_b, out_b = await replay_run(args.seed)
@@ -191,7 +312,7 @@ async def main_async(args):
     if len(seq_a) > 6:
         print(f"    ... {len(seq_a) - 6} more")
     print("=== done ===")
-    if ok < 0.99 * args.requests or dupes or not same:
+    if ok < 0.99 * args.requests or dupes or not same or not supervised_ok:
         return 1
     return 0
 

@@ -14,6 +14,7 @@ import argparse
 import asyncio
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -52,12 +53,18 @@ async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> Non
         h, p = w.address
         coord.add_worker(w.worker_id, h, p)
 
+    # every worker shares one serving-artifact dir: the first slow-path
+    # load commits it, every later load (the respawn below included) is
+    # an artifact cold-start
+    art_dir = tempfile.mkdtemp(prefix="fleet_artifact_")
     model = ModelConfig(
         name="tiny", architecture="llama", max_seq_len=64, dtype="float32",
-        metadata={"size": "llama-tiny"},
+        metadata={"size": "llama-tiny",
+                  "artifact": os.path.join(art_dir, "tiny")},
     )
     n = await coord.deploy_model(model)
-    print(f"  deployed {model.name} across {n} workers")
+    print(f"  deployed {model.name} across {n} workers "
+          f"(serving artifact at {art_dir})")
 
     served = {w.worker_id: 0 for w in workers}
     errors = 0
@@ -87,7 +94,8 @@ async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> Non
     await asyncio.gather(*(one(half + i) for i in range(q3 - half)))
     if kill:
         # elastic respawn: a fresh worker joins mid-run and deploy_model's
-        # idempotent scale-out loads the model onto it only
+        # idempotent scale-out loads the model onto it only — from the
+        # committed artifact, so the join is seconds, not a re-derivation
         respawn = WorkerServer(ServerConfig(worker_id=f"w{n_workers}",
                                             host="127.0.0.1", port=0))
         await respawn.start()
@@ -96,7 +104,12 @@ async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> Non
         await coord.deploy_model(model)
         served[respawn.worker_id] = 0
         workers.append(respawn)
-        print(f"  ++ respawned capacity as {respawn.worker_id} on port {p}")
+        load_s = respawn._last_load_s.get(model.name, 0.0)
+        hit = getattr(respawn.engines.get(model.name),
+                      "artifact_manifest", None) is not None
+        print(f"  ++ respawned capacity as {respawn.worker_id} on port {p} "
+              f"— load_model took {load_s:.2f}s"
+              f"{' [artifact cold-start]' if hit else ' [slow path]'}")
     await asyncio.gather(*(one(q3 + i) for i in range(n_requests - q3)))
     wall = time.perf_counter() - t0
 

@@ -75,6 +75,19 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2d: fast elastic-lifecycle leg -----------------------------
+# serving-artifact round-trip/corruption/cold-start + supervisor
+# respawn/crash-loop tests (-m elastic): a broken artifact or respawn
+# path fails here before the full sweep.
+echo "== elastic lifecycle (-m 'elastic and not slow') =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'elastic and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: elastic lifecycle leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.

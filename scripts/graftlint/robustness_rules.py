@@ -15,6 +15,17 @@ health-bookkeeping method, nor touches a health/error field, nor even
 reads the bound exception. Sites that are genuinely benign (best-effort
 cleanup, optional probes) say so with a pragma — that reason string IS
 the audit trail the chaos round asked for.
+
+``non-atomic-serving-write``: a direct write-mode ``open()`` (or
+``Path.write_text``/``write_bytes``) in the persistence plane — the
+serving-plane modules plus obs/, ``utils/checkpoint.py`` and
+``engine/artifact.py``. The elastic-lifecycle round made torn files an
+availability event: a worker that crashes mid-write leaves a truncated
+artifact manifest / metrics snapshot that the NEXT boot chokes on.
+Everything durable goes through ``utils/files.atomic_write*`` (tmp +
+fsync + rename) so readers see the old bytes or the new bytes, never a
+prefix. Sites where a torn file is provably harmless (append-only logs
+whose readers tolerate truncation) take the pragma with a reason.
 """
 
 from __future__ import annotations
@@ -128,4 +139,78 @@ class SwallowedTransportError(Rule):
                 f"health or reading the error — feed it to the health "
                 f"machinery (mark_worker_failure/_record_failure), "
                 f"re-raise, or pragma why it is benign"))
+        return out
+
+
+# modules whose on-disk output other processes load at boot: a torn write
+# here becomes a cold-start failure, not just a bad log line
+_PERSISTENCE_EXTRA = ("/obs/",)
+_PERSISTENCE_FILES = ("utils/checkpoint.py", "engine/artifact.py")
+
+# open() modes that create/modify bytes; "r", "rb", "r+" stay untouched —
+# "r+" could tear too, but in-place patching is rare enough that a false
+# negative beats flagging every seek-and-fix helper
+_WRITE_MODE_CHARS = ("w", "a", "x")
+
+
+def _in_persistence_plane(relpath: str) -> bool:
+    return _in_serving_plane(relpath) or \
+        any(part in relpath for part in _PERSISTENCE_EXTRA) or \
+        any(relpath.endswith(f) for f in _PERSISTENCE_FILES)
+
+
+def _write_open_label(call: ast.Call) -> str:
+    """Non-empty label when ``call`` opens a file for writing."""
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else "")
+    if name in ("write_text", "write_bytes") and \
+            isinstance(fn, ast.Attribute):
+        return f".{name}()"
+    if name != "open":
+        return ""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or \
+            not isinstance(mode.value, str):
+        return ""                       # no/ dynamic mode = default "r"
+    if any(c in mode.value for c in _WRITE_MODE_CHARS):
+        return f"open(..., {mode.value!r})"
+    return ""
+
+
+@register
+class NonAtomicServingWrite(Rule):
+    id = "non-atomic-serving-write"
+    family = "robustness"
+    severity = "error"
+    doc = ("direct write-mode open()/write_text()/write_bytes() in the "
+           "persistence plane — a crash mid-write leaves a torn file the "
+           "next cold-start chokes on; route it through "
+           "utils/files.atomic_write* (tmp + fsync + rename) or pragma "
+           "why a torn file is harmless")
+
+    def check_module(self, mod: ModuleInfo,
+                     project: Project) -> Iterable[Finding]:
+        if mod.tree is None or not _in_persistence_plane(mod.relpath):
+            return ()
+        if mod.relpath.endswith("utils/files.py"):
+            return ()                   # the atomic helpers themselves
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _write_open_label(node)
+            if not label:
+                continue
+            out.append(self.finding(
+                mod, node.lineno,
+                f"`{label}` writes durable state without the tmp+rename "
+                f"protocol — a crash here leaves a truncated file for "
+                f"the next boot; use utils/files.atomic_write / "
+                f"atomic_write_json, or pragma why tearing is harmless"))
         return out

@@ -61,6 +61,10 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection / failover tests (seeded "
         "FaultPlan, deadlines, drain, kill/respawn; fast leg: pytest -m "
         "'chaos and not slow')")
+    config.addinivalue_line(
+        "markers", "elastic: elastic worker lifecycle tests (serving "
+        "artifact round-trip/corruption, supervisor respawn, crash-loop "
+        "breaker; fast leg: pytest -m 'elastic and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -365,6 +365,76 @@ def test_swallowed_transport_error_pragma_suppresses():
     assert mine and all(f.suppressed_by == "pragma" for f in mine)
 
 
+# ------------------------------------------- non-atomic-serving-write
+
+ATOMIC_BAD_OPEN = """
+    import json
+
+    def dump(self, path, obj):
+        with open(path, "w") as f:
+            json.dump(obj, f)
+"""
+
+ATOMIC_BAD_WRITE_TEXT = """
+    import json, pathlib
+
+    def save(self, path, obj):
+        pathlib.Path(path).write_text(json.dumps(obj))
+"""
+
+
+def test_non_atomic_serving_write_fires_in_persistence_plane():
+    # the serving plane, obs/, and the two named artifact/checkpoint
+    # modules are all "persistence plane"
+    for rel in ("pkg/api/x.py", "pkg/obs/x.py",
+                "pkg/utils/checkpoint.py", "pkg/engine/artifact.py"):
+        assert "non-atomic-serving-write" in rules_fired(
+            ATOMIC_BAD_OPEN, relpath=rel), rel
+    assert "non-atomic-serving-write" in rules_fired(
+        ATOMIC_BAD_WRITE_TEXT, relpath="pkg/cluster/x.py")
+    # mode= keyword and append mode count too
+    kw_mode = """
+        def log(self, path, line):
+            with open(path, mode="a") as f:
+                f.write(line)
+    """
+    assert "non-atomic-serving-write" in rules_fired(
+        kw_mode, relpath="pkg/obs/x.py")
+
+
+def test_non_atomic_serving_write_silent_outside_plane_and_on_reads():
+    assert "non-atomic-serving-write" not in rules_fired(
+        ATOMIC_BAD_OPEN, relpath="pkg/models/x.py")
+    reads = """
+        import json
+
+        def load(self, path):
+            with open(path) as f:
+                return json.load(f)
+
+        def load_b(self, path):
+            with open(path, "rb") as f:
+                return f.read()
+    """
+    assert "non-atomic-serving-write" not in rules_fired(
+        reads, relpath="pkg/api/x.py")
+    # the atomic helper's own implementation is exempt
+    assert "non-atomic-serving-write" not in rules_fired(
+        ATOMIC_BAD_OPEN, relpath="pkg/utils/files.py")
+
+
+def test_non_atomic_serving_write_pragma_suppresses():
+    src = """
+        def append_line(self, path, line):
+            # graftlint: ok[non-atomic-serving-write] append-only log, readers tolerate truncation
+            with open(path, "a") as f:
+                f.write(line)
+    """
+    findings = lint_source(textwrap.dedent(src), relpath="pkg/api/x.py")
+    mine = [f for f in findings if f.rule == "non-atomic-serving-write"]
+    assert mine and all(f.suppressed_by == "pragma" for f in mine)
+
+
 # ------------------------------------------------------------------- pragmas
 
 def test_pragma_suppresses_same_line_and_line_above():
