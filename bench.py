@@ -70,6 +70,26 @@ Env knobs:
                    (default 0 = off / 2048)
     BENCH_FORCE_CPU  1 = skip the TPU probe and emit the CPU-fallback
                    result line (driver smoke-testing)
+    fleet sweep (examples/fleet_sweep.py — fake-fleet goodput scaling
+                   through the coordinator; the constants are read HERE so
+                   the knob catalog stays one file):
+                   BENCH_FLEET_DIR (per-leg fleet JSON output dir, default
+                   bench_obs; "0" disables), BENCH_FLEET_NS (fleet sizes,
+                   default 1,2,4), BENCH_FLEET_REQUESTS (requests per
+                   worker per leg, default 160), BENCH_FLEET_RATE (offered
+                   req/s per worker, default 120 — ~20% past a fake
+                   worker's capacity so the scaling legs measure sustained
+                   goodput, not offered load), BENCH_FLEET_NEW_TOKENS
+                   (default 16), BENCH_FLEET_STEP_MS (fake decode step
+                   latency, default 5), BENCH_FLEET_SLOTS (fake decode
+                   slots, default 8), BENCH_FLEET_SEED (arrivals + retry
+                   jitter, default 1234), BENCH_FLEET_TINY (1 = run the
+                   llama-tiny disaggregated token-exactness leg, default 1)
+    The sweep's non-BENCH knobs (SWEEP_* family, shared naming with
+    examples/serving_sweep.py): serving_sweep reads SWEEP_RATES /
+    SWEEP_REQUESTS / SWEEP_TRIALS / SWEEP_SHAPE; fleet_sweep reads
+    SWEEP_LEGS (comma list to run a subset of
+    replicated,disagg,affinity,kill,tiny).
 """
 
 import json
@@ -157,6 +177,21 @@ def pct(xs, q: float):
     """Nearest-rank percentile (shared with examples/serving_sweep.py)."""
     return (sorted(xs)[min(len(xs) - 1, math.ceil(q * len(xs)) - 1)]
             if xs else 0.0)
+
+
+# Fleet-sweep knobs (examples/fleet_sweep.py imports these; docstring above
+# documents them — reading them here keeps every BENCH_* knob in one file
+# for the knob-drift check). Shapes the fake fleet and its offered load.
+FLEET_DIR = os.environ.get("BENCH_FLEET_DIR", "bench_obs")
+FLEET_NS = [int(n) for n in
+            os.environ.get("BENCH_FLEET_NS", "1,2,4").split(",")]
+FLEET_REQUESTS = int(os.environ.get("BENCH_FLEET_REQUESTS", "160"))
+FLEET_RATE = float(os.environ.get("BENCH_FLEET_RATE", "120"))
+FLEET_NEW_TOKENS = int(os.environ.get("BENCH_FLEET_NEW_TOKENS", "16"))
+FLEET_STEP_MS = float(os.environ.get("BENCH_FLEET_STEP_MS", "5"))
+FLEET_SLOTS = int(os.environ.get("BENCH_FLEET_SLOTS", "8"))
+FLEET_SEED = int(os.environ.get("BENCH_FLEET_SEED", "1234"))
+FLEET_TINY = os.environ.get("BENCH_FLEET_TINY", "1") not in ("0", "")
 
 
 def _probe_tpu(timeout_s: float = 120.0) -> bool:

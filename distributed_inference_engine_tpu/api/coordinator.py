@@ -77,6 +77,14 @@ class CoordinatorConfig:
     lb_strategy: str = LoadBalancerStrategy.ROUND_ROBIN.value
     dispatch_timeout_s: float = 120.0
     cache_enabled: bool = True
+    # prefix-affinity routing (lb_strategy="prefix_affinity"): the affinity
+    # key is the chain hash of the request's leading FULL prompt pages —
+    # the same page_chain_hashes the prefix cache and host-KV tier key on,
+    # so "same key" means "that worker's cache is warm for this prefix".
+    # affinity_pages caps how many pages the key commits to: requests that
+    # share a long system prefix but diverge in the tail still co-locate.
+    affinity_pages: int = 4
+    affinity_page_size: int = 64
     # retry budget: how many RE-dispatches a failed batch/stream gets
     # (transport failures and draining sheds only — queue_full sheds keep
     # the one-alternate contract and deadlines never retry), each preceded
@@ -427,6 +435,7 @@ class Coordinator:
         cfg: ModelConfig,
         worker_ids: Optional[Sequence[str]] = None,
         load_timeout_s: float = 600.0,
+        register_shards: bool = True,
     ) -> int:
         """Load ``cfg`` onto workers and register one shard per worker.
 
@@ -434,6 +443,13 @@ class Coordinator:
         those shards (reference deploy flow scattered across
         ``examples/worker_demo.py`` + ``examples/router_demo.py``, unified).
         Returns the number of shards deployed.
+
+        With ``register_shards=False`` the model is loaded as a pure replica
+        set instead: every worker hosts the full model and no shards are
+        registered, so requests route through the load balancer (including
+        the ``prefix_affinity`` strategy) rather than the registry's
+        consistent hashing. This is the deployment mode the replicated and
+        affinity legs of ``examples/fleet_sweep.py`` measure.
         """
         targets = list(worker_ids) if worker_ids else list(self.router.workers)
         if not targets:
@@ -454,9 +470,11 @@ class Coordinator:
             # worker-side load is idempotent for an identical config and
             # errors on a mismatched one — no error-text sniffing needed
             await client.load_model(cfg, timeout=load_timeout_s)
-            self.registry.add_shard(cfg.name, cfg.version, shard_id=next_id,
-                                    worker_id=wid, status=ModelStatus.READY)
-            next_id += 1
+            if register_shards:
+                self.registry.add_shard(
+                    cfg.name, cfg.version, shard_id=next_id,
+                    worker_id=wid, status=ModelStatus.READY)
+                next_id += 1
             deployed += 1
         return deployed
 
@@ -534,6 +552,23 @@ class Coordinator:
                 return wid
         raise RoutingError("no healthy prefill worker")
 
+    def _prefix_affinity_key(self, prompt: Sequence[int]) -> Optional[str]:
+        """The request's routing key under ``prefix_affinity``: the chain
+        hash of its leading full prompt pages (capped at
+        ``affinity_pages``), hex-encoded so it rides ``inputs["key"]`` over
+        the wire. ``None`` when the strategy is different or the prompt is
+        shorter than one page — those requests spread normally."""
+        if self.lb.strategy is not LoadBalancerStrategy.PREFIX_AFFINITY:
+            return None
+        page = self.config.affinity_page_size
+        n_pages = min(len(prompt) // page, self.config.affinity_pages) \
+            if page > 0 else 0
+        if n_pages <= 0:
+            return None
+        from ..engine.paged_kv import page_chain_hashes
+
+        return page_chain_hashes(list(prompt), n_pages, page)[-1].hex()
+
     # -- request path -------------------------------------------------------
 
     async def submit(
@@ -583,7 +618,13 @@ class Coordinator:
             raise ValueError("empty prompt")
         self._submitted += 1
         request_id = request_id or new_request_id()
-        affinity = key if key is not None else request_id
+        # two routing handles: "key" feeds the sharded path's consistent
+        # hashing (always non-None), "affinity" feeds the LB's
+        # prefix_affinity strategy -- None for short/keyless prompts, which
+        # must spread via the keyless fallback instead of polluting the
+        # binding table with one-shot request ids
+        affinity = key if key is not None else \
+            self._prefix_affinity_key(prompt)
         trace = RequestTrace(request_id=request_id)
         trace.mark("received")
 
@@ -624,7 +665,8 @@ class Coordinator:
             "stop_ids": list(stop_ids or ()),
             "stop_sequences": [list(sq) for sq in (stop_sequences or ())],
             "request_id": request_id,
-            "key": affinity,
+            "key": affinity if affinity is not None else request_id,
+            "affinity": affinity,
             "deadline_s": deadline_s,
             # coordinator-local keys (request_from_dict ignores them, they
             # never cross the wire): the live trace so _run_batch can mark
@@ -714,16 +756,23 @@ class Coordinator:
             raise ValueError("empty prompt")
         self._submitted += 1
         request_id = request_id or new_request_id()
-        affinity = key if key is not None else request_id
+        # two routing handles: "key" feeds the sharded path's consistent
+        # hashing (always non-None), "affinity" feeds the LB's
+        # prefix_affinity strategy -- None for short/keyless prompts, which
+        # must spread via the keyless fallback instead of polluting the
+        # binding table with one-shot request ids
+        affinity = key if key is not None else \
+            self._prefix_affinity_key(prompt)
         trace = RequestTrace(request_id=request_id)
         trace.mark("received")
 
+        route_key = affinity if affinity is not None else request_id
         sharded = bool(self.registry.all_shards(model, version))
         if sharded:
             worker_id = self.router.route_request(
-                model, version, affinity).worker.worker_id
+                model, version, route_key).worker.worker_id
         else:
-            worker_id = self.lb.get_worker().worker_id
+            worker_id = self.lb.get_worker(affinity=affinity).worker_id
         trace.mark("routed")
 
         req = request_from_dict({
@@ -779,11 +828,15 @@ class Coordinator:
             except TRANSPORT_ERRORS as e:
                 alt = (None if attempt >= self.config.max_dispatch_retries
                        else self._pick_alternate(model, version, worker_id,
-                                                 affinity, sharded,
+                                                 route_key, sharded,
                                                  exclude=tried))
                 if alt is None:
                     raise
                 tried.add(alt)
+                # the replay lands the prefix on the alternate: any affinity
+                # binding still pointing at the dead worker is known-stale
+                # even though its breaker may not have tripped yet
+                self.lb.invalidate_affinity(worker_id)
                 attempt += 1
                 self._dispatch_retries += 1
                 if delivered:
@@ -821,7 +874,7 @@ class Coordinator:
                     alt = (None
                            if attempt >= self.config.max_dispatch_retries
                            else self._pick_alternate(model, version,
-                                                     worker_id, affinity,
+                                                     worker_id, route_key,
                                                      sharded, exclude=tried))
                     if alt is not None:
                         tried.add(alt)
@@ -841,7 +894,7 @@ class Coordinator:
                         "tokens streamed; back off and retry",
                         reason=reason) from e
                 alt = self._pick_alternate(model, version, worker_id,
-                                           affinity, sharded, exclude=tried)
+                                           route_key, sharded, exclude=tried)
                 if alt is None:
                     self._overload_rejections += 1
                     raise EngineOverloadedError(
@@ -958,6 +1011,17 @@ class Coordinator:
                     continue
                 self._trace_mark(inp, "routed")
                 groups.setdefault(route.worker.worker_id, []).append(idx)
+        elif self.lb.strategy is LoadBalancerStrategy.PREFIX_AFFINITY:
+            # per-request affinity picks: same-prefix requests in one batch
+            # group onto the same (warm) worker, cold prefixes spread
+            for idx, inp in enumerate(reals):
+                try:
+                    picked = self.lb.get_worker(affinity=inp.get("affinity"))
+                except Exception as e:
+                    results[idx] = e
+                    continue
+                self._trace_mark(inp, "routed")
+                groups.setdefault(picked.worker_id, []).append(idx)
         else:
             picked = self.lb.get_worker()
             for inp in reals:
@@ -1117,6 +1181,8 @@ class Coordinator:
                 if alt is None:
                     raise err
                 tried.add(alt)
+                # moving the batch off wid: its affinity bindings are stale
+                self.lb.invalidate_affinity(wid)
             attempt += 1
             self._dispatch_retries += 1
             delay = self._retry_backoff_s(attempt - 1)
@@ -1514,4 +1580,21 @@ class Coordinator:
                 m: {"prefill": p.prefill_ids, "decode": p.decode_ids}
                 for m, p in self._disagg.items()
             },
+            "worker_roles": self._worker_roles(),
         }
+
+    def _worker_roles(self) -> Dict[str, str]:
+        """Fleet role per registered worker for the scrape: pool membership
+        wins (a disaggregated deploy is authoritative), then the worker's
+        registration metadata, then the plain-replica default."""
+        roles: Dict[str, str] = {}
+        for pool in self._disagg.values():
+            for wid in pool.prefill_ids:
+                if wid in self.router.workers:
+                    roles[wid] = "prefill"
+            for wid in pool.decode_ids:
+                if wid in self.router.workers:
+                    roles[wid] = "decode"
+        for wid, info in self.router.workers.items():
+            roles.setdefault(wid, str(info.metadata.get("role", "replica")))
+        return roles

@@ -30,8 +30,9 @@ import itertools
 import logging
 import random
 import time
+from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Hashable, List, Optional, Tuple
 
 from ..config import HealthConfig
 from .worker import WorkerClient
@@ -46,6 +47,10 @@ class LoadBalancerStrategy(str, enum.Enum):
     LEAST_CONNECTIONS = "least_connections"
     RANDOM = "random"
     LEAST_LATENCY = "least_latency"
+    # KV-locality-aware spreading (PRESERVE-style): requests carrying the
+    # same prefix-chain hash stick to the worker whose prefix cache is warm;
+    # cold prefixes fall back to least-connections
+    PREFIX_AFFINITY = "prefix_affinity"
 
 
 # per-worker circuit breaker states (docs/design.md "Failure model"):
@@ -99,6 +104,7 @@ class LoadBalancer:
         strategy: LoadBalancerStrategy = LoadBalancerStrategy.ROUND_ROBIN,
         health: Optional[HealthConfig] = None,
         seed: Optional[int] = None,
+        affinity_capacity: int = 4096,
     ) -> None:
         self.strategy = LoadBalancerStrategy(strategy)
         self.health_config = health or HealthConfig()
@@ -112,11 +118,21 @@ class LoadBalancer:
         self._bg_tasks: set = set()
         self._running = False
         self._pick_count = 0
+        # prefix-affinity binding table: prefix key -> worker_id, LRU-bounded
+        # so a long-tail of one-shot prefixes can't grow it without bound
+        self._affinity: "OrderedDict[Hashable, str]" = OrderedDict()
+        self._affinity_capacity = affinity_capacity
+        self._affinity_hits = 0
+        self._affinity_misses = 0
+        self._affinity_rebinds = 0
         self._strategies = {
             LoadBalancerStrategy.ROUND_ROBIN: self._round_robin,
             LoadBalancerStrategy.LEAST_CONNECTIONS: self._least_connections,
             LoadBalancerStrategy.RANDOM: self._random,
             LoadBalancerStrategy.LEAST_LATENCY: self._least_latency,
+            # keyless requests under prefix_affinity spread like
+            # least-connections; keyed picks short-circuit in get_worker
+            LoadBalancerStrategy.PREFIX_AFFINITY: self._least_connections,
         }
 
     # -- lifecycle ----------------------------------------------------------
@@ -152,6 +168,8 @@ class LoadBalancer:
 
     def unregister_worker(self, worker_id: str) -> bool:
         stats = self.workers.pop(worker_id, None)
+        if stats is not None:
+            self.invalidate_affinity(worker_id)
         client = self._clients.pop(worker_id, None)
         if client is not None:
             # tear in-flight calls NOW: their pending reads fail fast as
@@ -217,6 +235,7 @@ class LoadBalancer:
         if s is None:
             return False
         self._open_breaker(s)
+        self.invalidate_affinity(worker_id)
         return True
 
     def enter_half_open(self, worker_id: str) -> bool:
@@ -236,9 +255,17 @@ class LoadBalancer:
     def healthy_workers(self) -> List[WorkerStats]:
         return [s for s in self.workers.values() if self._is_healthy(s)]
 
-    def get_worker(self, pinned: Optional[str] = None) -> WorkerStats:
+    def get_worker(self, pinned: Optional[str] = None,
+                   affinity: Optional[Hashable] = None) -> WorkerStats:
         """Pick a worker; ``pinned`` forces a specific healthy worker
-        (reference pinned-worker path, ``src/load_balancer.py:144-147``)."""
+        (reference pinned-worker path, ``src/load_balancer.py:144-147``).
+
+        Under ``PREFIX_AFFINITY``, ``affinity`` is the request's prefix-chain
+        hash: a live binding to a healthy worker is a *hit* (same-prefix
+        traffic lands on the warm cache), a cold key is a *miss* (bound to
+        the least-loaded worker), and a binding whose worker has died,
+        drained, or tripped its breaker is *rebound* to a healthy one —
+        requests are never dropped for affinity's sake."""
         self._pick_count += 1
         if pinned is not None:
             s = self.workers.get(pinned)
@@ -249,7 +276,54 @@ class LoadBalancer:
         if not healthy:
             raise NoHealthyWorkerError("no healthy workers registered")
         healthy.sort(key=lambda s: s.worker_id)   # deterministic strategy input
+        if (self.strategy == LoadBalancerStrategy.PREFIX_AFFINITY
+                and affinity is not None):
+            return self._affine_pick(affinity, healthy)
         return self._strategies[self.strategy](healthy)
+
+    def _affine_pick(self, key: Hashable,
+                     healthy: List[WorkerStats]) -> WorkerStats:
+        bound = self._affinity.get(key)
+        if bound is not None:
+            s = self.workers.get(bound)
+            if s is not None and self._is_healthy(s):
+                self._affinity_hits += 1
+                self._affinity.move_to_end(key)
+                return s
+            # bound worker is gone/unhealthy: rebind, don't drop the request
+            self._affinity_rebinds += 1
+        else:
+            self._affinity_misses += 1
+        # cold-prefix placement: least-connections, tie-broken by how many
+        # bindings each worker already holds — bare active_connections ties
+        # to the first worker on an idle fleet, piling every cold prefix
+        # onto one replica
+        held = Counter(self._affinity.values())
+        s = min(healthy, key=lambda w: (w.active_connections,
+                                        held.get(w.worker_id, 0),
+                                        w.request_count))
+        self._bind_affinity(key, s.worker_id)
+        return s
+
+    def _bind_affinity(self, key: Hashable, worker_id: str) -> None:
+        self._affinity[key] = worker_id
+        self._affinity.move_to_end(key)
+        while len(self._affinity) > self._affinity_capacity:
+            self._affinity.popitem(last=False)
+
+    def invalidate_affinity(self, worker_id: Optional[str] = None) -> int:
+        """Drop bindings to ``worker_id`` (or all when None); subsequent
+        same-prefix picks rebind fresh. Called automatically on unregister/
+        quarantine, and explicitly by the coordinator when a streaming
+        failover replays a prefix onto an alternate (the old binding is
+        known-stale even though the breaker may not have tripped yet).
+        Each dropped binding counts as a rebind."""
+        stale = [k for k, w in self._affinity.items()
+                 if worker_id is None or w == worker_id]
+        for k in stale:
+            del self._affinity[k]
+        self._affinity_rebinds += len(stale)
+        return len(stale)
 
     def _round_robin(self, healthy: List[WorkerStats]) -> WorkerStats:
         return healthy[next(self._rr) % len(healthy)]
@@ -371,4 +445,8 @@ class LoadBalancer:
             "pick_count": self._pick_count,
             "workers": {wid: self.get_worker_stats(wid) for wid in self.workers},
             "healthy_count": len(self.healthy_workers()),
+            "affinity_hits": self._affinity_hits,
+            "affinity_misses": self._affinity_misses,
+            "affinity_rebinds": self._affinity_rebinds,
+            "affinity_bindings": len(self._affinity),
         }

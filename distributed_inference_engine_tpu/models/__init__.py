@@ -15,7 +15,7 @@ from .llama import llama_spec, mixtral_spec  # noqa: F401
 from .qwen import qwen_spec  # noqa: F401
 from .mistral import mistral_spec  # noqa: F401
 from .gemma import gemma_spec  # noqa: F401
-from .fake import FakeContinuousEngine, FakeEngine  # noqa: F401
+from .fake import FakeContinuousEngine, FakeEngine, FakePrefillEngine  # noqa: F401
 
 logger = logging.getLogger(__name__)
 
@@ -71,6 +71,15 @@ def engine_from_config(cfg):
 
     arch = cfg.architecture.lower()
     if arch == "fake":
+        if cfg.metadata.get("role") == "prefill":
+            # prefill-pool fake: chain-consistent handoffs over the real
+            # wire format, so disaggregated fleets test jax-free
+            return FakePrefillEngine(
+                latency_s=float(cfg.metadata.get("latency_s", 0.0)),
+                per_token_latency_s=float(
+                    cfg.metadata.get("per_token_latency_s", 0.0)),
+                max_seq_len=int(cfg.max_seq_len),
+            )
         if cfg.metadata.get("continuous"):
             # continuous fake: submit/step interface, so the worker builds
             # an EnginePump around it — streaming, deadlines, and drain
@@ -82,6 +91,11 @@ def engine_from_config(cfg):
                 max_waiting=int(cfg.metadata.get("max_waiting", 0)),
                 queue_deadline_s=float(
                     cfg.metadata.get("queue_deadline_s", 0.0)),
+                admit_latency_per_token_s=float(
+                    cfg.metadata.get("admit_latency_per_token_s", 0.0)),
+                prefix_cache=bool(cfg.metadata.get("prefix_cache", False)),
+                prefix_page_size=int(
+                    cfg.metadata.get("prefix_page_size", 64)),
             )
         return FakeEngine(
             latency_s=float(cfg.metadata.get("latency_s", 0.0)),

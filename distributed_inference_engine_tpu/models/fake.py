@@ -21,6 +21,8 @@ import zlib
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from ..engine.types import (
     EngineOverloadedError,
     GenerationRequest,
@@ -126,7 +128,10 @@ class FakeContinuousEngine:
 
     def __init__(self, step_latency_s: float = 0.0, tokens_per_step: int = 1,
                  max_slots: int = 8, max_waiting: int = 0,
-                 queue_deadline_s: float = 0.0, vocab_size: int = 997) -> None:
+                 queue_deadline_s: float = 0.0, vocab_size: int = 997,
+                 admit_latency_per_token_s: float = 0.0,
+                 prefix_cache: bool = False,
+                 prefix_page_size: int = 64) -> None:
         self.config = FakeEngineConfig(
             max_waiting=int(max_waiting),
             queue_deadline_s=float(queue_deadline_s))
@@ -134,6 +139,19 @@ class FakeContinuousEngine:
         self.tokens_per_step = max(1, int(tokens_per_step))
         self.max_slots = max(1, int(max_slots))
         self.vocab_size = max(2, int(vocab_size))
+        # prefix-cache TTFT model: admission costs
+        # admit_latency_per_token_s per UNCACHED prompt token (the fake's
+        # stand-in for prefill compute), and with prefix_cache on, page-
+        # aligned prompt heads this engine has already admitted are free —
+        # so routing same-prefix traffic to the same worker (the LB's
+        # prefix_affinity strategy) measurably improves TTFT, exactly the
+        # effect the fleet sweep's affinity leg quantifies
+        self.admit_latency_per_token_s = float(admit_latency_per_token_s)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_page_size = max(1, int(prefix_page_size))
+        self._prefix_seen: set = set()
+        self._prefix_cached_tokens = 0
+        self._admit_sleep_s = 0.0
         # waiting: (request, on_tokens, t_submit); live: [req, cb, t_submit,
         # chain state, tokens]
         self._waiting: List[tuple] = []
@@ -145,6 +163,7 @@ class FakeContinuousEngine:
         self._rejected_full = 0
         self._shed_deadline = 0
         self._deadline_expired = 0
+        self._prefilled_admitted = 0
 
     # ------------------------------------------------------------- submit
 
@@ -160,7 +179,36 @@ class FakeContinuousEngine:
         self._total_requests += 1
         if not request.request_id:
             request.request_id = f"fcreq-{self._total_requests}"
-        self._waiting.append((request, on_tokens, time.perf_counter()))
+        self._waiting.append((request, on_tokens, time.perf_counter(), None))
+        return request.request_id
+
+    def submit_prefilled(self, request: GenerationRequest, handoff,
+                         on_tokens=None) -> str:
+        """Disaggregated admission (the ``submit_prefilled`` capability the
+        worker's decode-pool RPCs check for): the handoff's ``first_token``
+        was produced by the prefill pool, so this engine seeds the slot
+        with it and decodes from position ``prompt_len + 1``. The crc32
+        chain makes a ``FakePrefillEngine`` handoff chain-consistent: the
+        disaggregated output is token-for-token what a single fake engine
+        would have generated."""
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        if int(handoff.prompt_len) != len(request.prompt):
+            raise ValueError(
+                f"handoff prompt_len {handoff.prompt_len} != prompt length "
+                f"{len(request.prompt)} for {request.request_id!r}")
+        cap = self.config.max_waiting
+        if cap and len(self._waiting) >= cap:
+            self._rejected_full += 1
+            raise EngineOverloadedError(
+                f"waiting queue full ({len(self._waiting)}/{cap}); retry "
+                "on another replica or later", reason="queue_full")
+        self._total_requests += 1
+        self._prefilled_admitted += 1
+        if not request.request_id:
+            request.request_id = f"fcreq-{self._total_requests}"
+        self._waiting.append((request, on_tokens, time.perf_counter(),
+                              int(handoff.first_token)))
         return request.request_id
 
     # --------------------------------------------------------------- step
@@ -170,7 +218,7 @@ class FakeContinuousEngine:
         now = time.perf_counter()
         cut = (now - queue_deadline) if queue_deadline else None
         keep = []
-        for req, cb, t in self._waiting:
+        for req, cb, t, first in self._waiting:
             if cut is not None and t <= cut:
                 self._shed_deadline += 1
                 self._finished.append(GenerationResult(
@@ -185,19 +233,65 @@ class FakeContinuousEngine:
                     finish_reason="deadline", prompt_tokens=len(req.prompt),
                     ttft_s=now - t, metadata={"deadline_s": req.deadline_s}))
             else:
-                keep.append((req, cb, t))
+                keep.append((req, cb, t, first))
         self._waiting = keep
+
+    def _admit_prefix(self, prompt: List[int]) -> int:
+        """Return how many prompt tokens this admission must pay for, after
+        crediting page-aligned prefixes this engine has already seen (when
+        ``prefix_cache`` is on), and record the new prefixes as warm."""
+        if not self.prefix_cache:
+            return len(prompt)
+        page = self.prefix_page_size
+        full_pages = len(prompt) // page
+        warm_pages = 0
+        for j in range(full_pages, 0, -1):
+            if tuple(prompt[:j * page]) in self._prefix_seen:
+                warm_pages = j
+                break
+        for j in range(1, full_pages + 1):
+            self._prefix_seen.add(tuple(prompt[:j * page]))
+        cached = warm_pages * page
+        self._prefix_cached_tokens += cached
+        return len(prompt) - cached
 
     def step(self) -> int:
         """One decode step for every live slot (admitting from the waiting
         queue first); returns the live count, like ``ContinuousEngine``."""
         self._shed_expired()
         while self._waiting and len(self._live) < self.max_slots:
-            req, cb, t = self._waiting.pop(0)
+            req, cb, t, first = self._waiting.pop(0)
+            if self.admit_latency_per_token_s and first is None:
+                uncached = self._admit_prefix(list(req.prompt))
+                if uncached:
+                    pause = self.admit_latency_per_token_s * uncached
+                    self._admit_sleep_s += pause
+                    time.sleep(pause)
             state = 0
             for tok in req.prompt:
                 state = _chain(state, tok)
-            self._live.append([req, cb, t, state, []])
+            toks: List[int] = []
+            if first is not None:
+                # prefilled admission: the handoff's first token is this
+                # chain state's own next token, so emitting it and folding
+                # it in keeps the continuation identical to a single engine
+                toks.append(first)
+                state = _chain(state, first)
+                self._total_generated += 1
+                if cb is not None:
+                    cb([first])
+                if (first == req.eos_id or first in (req.stop_ids or ())
+                        or len(toks) >= req.max_new_tokens):
+                    now0 = time.perf_counter()
+                    stopped = (first == req.eos_id
+                               or first in (req.stop_ids or ()))
+                    self._finished.append(GenerationResult(
+                        request_id=req.request_id, tokens=toks,
+                        finish_reason="stop" if stopped else "length",
+                        prompt_tokens=len(req.prompt), ttft_s=now0 - t,
+                        decode_s=now0 - t, metadata={"fake": True}))
+                    continue
+            self._live.append([req, cb, t, state, toks])
         if not self._live:
             return 0
         if self.step_latency_s:
@@ -284,5 +378,90 @@ class FakeContinuousEngine:
             "rejected_queue_full": self._rejected_full,
             "shed_deadline": self._shed_deadline,
             "deadline_expired": self._deadline_expired,
+            "prefilled_admitted": self._prefilled_admitted,
+            "prefix_cached_tokens": self._prefix_cached_tokens,
+            "admit_sleep_s": self._admit_sleep_s,
             "spec": {"fake": True, "continuous": True},
+        }
+
+
+@dataclass
+class _FakePrefillSpec:
+    """The spec slice ``_rpc_prefill_generate``'s size estimate reads."""
+
+    n_layers: int = 1
+    n_kv_heads: int = 1
+    head_dim: int = 8
+
+
+class FakePrefillEngine:
+    """Prefill-pool fake: ``prefill()`` produces chain-consistent
+    ``PrefillHandoff``s with placeholder KV tensors, so the REAL wire
+    format, frame packing, size accounting, and decode-side admission all
+    run jax-free. ``first_token`` is the crc32 chain's next token for the
+    prompt — ``FakeContinuousEngine.submit_prefilled`` continues the chain
+    from it, making disaggregated output token-exact vs a single fake.
+
+    Carries the ``spec``/``kv_dtype``/``max_seq_len`` attributes the
+    worker's up-front handoff-size estimate reads (64 bytes/token at the
+    default shape — small on the wire but nonzero, so bytes/s telemetry
+    stays meaningful)."""
+
+    def __init__(self, latency_s: float = 0.0,
+                 per_token_latency_s: float = 0.0,
+                 max_seq_len: int = 2048, vocab_size: int = 997) -> None:
+        self.spec = _FakePrefillSpec()
+        self.kv_dtype = np.dtype("float32")
+        self.max_seq_len = max(2, int(max_seq_len))
+        self.config = FakeEngineConfig()
+        self.latency_s = float(latency_s)
+        self.per_token_latency_s = float(per_token_latency_s)
+        self.vocab_size = max(2, int(vocab_size))
+        self.prefill_stats = LatencyStats()
+        self._total_requests = 0
+        self._total_prompt_tokens = 0
+        self._total_handoff_bytes = 0
+
+    def prefill(self, requests: List[GenerationRequest]) -> List[Any]:
+        from ..engine.disagg import PrefillHandoff
+
+        t0 = time.perf_counter()
+        out = []
+        n_tokens = 0
+        for r in requests:
+            if not r.prompt:
+                raise ValueError("empty prompt")
+            # tail-truncate overlong prompts like the real engine, so the
+            # worker's prompt-length size bound stays an upper bound
+            prompt = list(r.prompt)[-(self.max_seq_len - 1):]
+            state = 0
+            for tok in prompt:
+                state = _chain(state, tok)
+            first = state % self.vocab_size
+            t = len(prompt)
+            shape = (self.spec.n_layers, t, self.spec.n_kv_heads,
+                     self.spec.head_dim)
+            h = PrefillHandoff(
+                request_id=r.request_id, prompt_len=t, first_token=first,
+                k=np.zeros(shape, self.kv_dtype),
+                v=np.zeros(shape, self.kv_dtype))
+            self._total_requests += 1
+            self._total_prompt_tokens += t
+            self._total_handoff_bytes += h.nbytes()
+            n_tokens += t
+            out.append(h)
+        delay = self.latency_s + self.per_token_latency_s * n_tokens
+        if delay:
+            time.sleep(delay)
+        self.prefill_stats.add(time.perf_counter() - t0)
+        return out
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "role": "prefill",
+            "total_requests": self._total_requests,
+            "total_prompt_tokens": self._total_prompt_tokens,
+            "total_handoff_bytes": self._total_handoff_bytes,
+            "prefill": self.prefill_stats.snapshot(),
+            "spec": {"fake": True, "prefill": True},
         }

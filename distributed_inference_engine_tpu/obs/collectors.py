@@ -223,6 +223,14 @@ ROUTER_TABLE = [                   # ShardRouter.get_stats()
 LB_TABLE = [                       # LoadBalancer.get_all_stats()
     ("pick_count", "lb_picks", "c", "Load-balancer worker picks"),
     ("healthy_count", "lb_healthy_workers", "g", "Healthy workers"),
+    ("affinity_hits", "lb_affinity_hits", "c",
+     "Prefix-affinity picks that landed on the bound (warm) worker"),
+    ("affinity_misses", "lb_affinity_misses", "c",
+     "Prefix-affinity picks with no live binding (cold prefix)"),
+    ("affinity_rebinds", "lb_affinity_rebinds", "c",
+     "Affinity bindings dropped or moved off a dead/drained worker"),
+    ("affinity_bindings", "lb_affinity_bindings", "g",
+     "Live prefix-to-worker affinity bindings"),
 ]
 
 LB_WORKER_TABLE = [                # get_all_stats()["workers"][wid]
@@ -306,6 +314,8 @@ EXTRA_FAMILIES = [
      "Routing decisions landing on this worker"),
     ("worker_rss_bytes", "g", WORKER_LABELS,
      "Worker process resident set size (psutil, 0 if unavailable)"),
+    ("fleet_worker_role", "g", ("worker_id", "role"),
+     "1 for the worker's fleet role: prefill / decode / replica"),
 ]
 
 _GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
@@ -474,6 +484,13 @@ def apply_coordinator(reg: MetricsRegistry,
     apply_router(reg, cs.get("router"))
     apply_lb(reg, cs.get("load_balancer"))
     apply_registry_stats(reg, cs.get("registry"))
+    roles = cs.get("worker_roles")
+    if isinstance(roles, Mapping):
+        fam = reg.gauge("fleet_worker_role",
+                        CATALOG["fleet_worker_role"][2],
+                        ("worker_id", "role"))
+        for wid, role in roles.items():
+            fam.labels(worker_id=str(wid), role=str(role)).set(1.0)
 
 
 def apply_worker(reg: MetricsRegistry, wm: Optional[Mapping[str, Any]],

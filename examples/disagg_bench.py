@@ -19,6 +19,18 @@ divide by your DCN bandwidth (docs/design.md's estimate, now measured).
 Usage:  python examples/disagg_bench.py
 Knobs:  BENCH_MODEL/BENCH_QUANT/BENCH_BATCH (default 16),
         BENCH_PROMPT (default 512), BENCH_NEW_TOKENS (default 128)
+
+``--coordinator`` runs the COORDINATOR-path mode instead (ISSUE 10): the
+same two pools, but deployed via ``deploy_model_disaggregated`` and driven
+through ``Coordinator.submit`` — requests cross the real framed-RPC control
+plane (coordinator -> prefill worker -> KV handoff -> decode worker),
+against a single-pool reference worker deployed on the same coordinator.
+The JSON row records handoff bytes (serialize/transfer, from the prefill
+worker's ``handoff_bytes_shipped`` counter), handoff bytes/s, end-to-end
+latency percentiles, and the coordinator-path overhead vs single-pool.
+
+    BENCH_MODEL=llama-tiny BENCH_PROMPT=32 BENCH_NEW_TOKENS=8 \
+        BENCH_BATCH=4 python examples/disagg_bench.py --coordinator
 """
 
 import asyncio
@@ -208,5 +220,96 @@ async def main():
     await dec.stop()
 
 
+async def main_coordinator():
+    """Coordinator-path mode: prefill + decode + single-pool reference
+    workers on one coordinator; both paths driven through
+    ``Coordinator.submit`` over the framed control plane. Workers build
+    their own engines from the ModelConfig (random-init, fixed key), so
+    the disagg path and the reference share weights and must agree
+    token-for-token at temperature 0."""
+    from distributed_inference_engine_tpu.api.coordinator import (
+        Coordinator, CoordinatorConfig,
+    )
+
+    n = bench.BATCH
+    max_seq = bench.PROMPT_LEN + bench.NEW_TOKENS
+    big = 2 * 1024 * 1024 * 1024
+    coord = Coordinator(CoordinatorConfig(dispatch_timeout_s=600.0))
+    await coord.start()
+    servers = {}
+    for wid in ("p0", "d0", "ref0"):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=wid, max_frame_bytes=big))
+        host, port = await w.start()
+        servers[wid] = w
+        coord.add_worker(wid, host, port)
+    t0 = time.perf_counter()
+    cfg = ModelConfig(name="m", architecture=bench.MODEL,
+                      max_seq_len=max_seq, max_batch_size=n,
+                      metadata={"continuous": 1, "max_slots": n})
+    ref = ModelConfig(name="m_ref", architecture=bench.MODEL,
+                      max_seq_len=max_seq, max_batch_size=n,
+                      metadata={"continuous": 1, "max_slots": n})
+    await coord.deploy_model_disaggregated(cfg, ["p0"], ["d0"])
+    await coord.deploy_model(ref, worker_ids=["ref0"])
+    log(f"coordinator fleet up ({bench.MODEL}, prompt {bench.PROMPT_LEN} "
+        f"+ {bench.NEW_TOKENS} new): {time.perf_counter() - t0:.1f}s")
+
+    import numpy as np
+    rs = np.random.RandomState(17)
+    prompts = [[int(rs.randint(1, 96)) for _ in range(bench.PROMPT_LEN)]
+               for _ in range(n)]
+
+    async def run(model, seed_tag):
+        lats = []
+        t0 = time.perf_counter()
+        outs = []
+        for i, p in enumerate(prompts):
+            t1 = time.perf_counter()
+            r = await coord.submit(model, prompt=p,
+                                   max_new_tokens=bench.NEW_TOKENS,
+                                   request_id=f"{seed_tag}{i}",
+                                   no_cache=True)
+            lats.append(time.perf_counter() - t1)
+            outs.append(r)
+        return outs, time.perf_counter() - t0, lats
+
+    # warmup/compile both paths, then the timed passes
+    await run("m", "warm")
+    await run("m_ref", "warmref")
+    m0 = await coord.router.client_for("p0").metrics()
+    outs, t_disagg, lats = await run("m", "c")
+    m1 = await coord.router.client_for("p0").metrics()
+    refs, t_single, ref_lats = await run("m_ref", "s")
+    shipped = (m1["handoff_bytes_shipped"] - m0["handoff_bytes_shipped"])
+    exact = sum(1 for a, b in zip(outs, refs)
+                if a["tokens"] == b["tokens"])
+    toks = sum(len(r["tokens"]) for r in outs)
+    row = {
+        "metric": f"disagg_coord_{bench.MODEL}_bs{n}_p{bench.PROMPT_LEN}",
+        "mode": "coordinator",
+        "requests": n,
+        "token_exact_vs_single": exact,
+        "handoff_mb_per_req": round(shipped / n / 1e6, 3),
+        "handoff_bytes_per_s": round(shipped / t_disagg, 1),
+        "disagg_e2e_s": round(t_disagg, 2),
+        "single_e2e_s": round(t_single, 2),
+        "disagg_tok_s": round(toks / t_disagg, 1),
+        "lat_p50_s": round(bench.pct(lats, 0.5), 3),
+        "lat_p99_s": round(bench.pct(lats, 0.99), 3),
+        "single_lat_p50_s": round(bench.pct(ref_lats, 0.5), 3),
+        "overhead_vs_single_pct": round(
+            100 * (t_disagg - t_single) / max(t_single, 1e-9), 1),
+    }
+    assert exact == n, f"coordinator disagg path diverged: {exact}/{n}"
+    print(json.dumps(row), flush=True)
+    await coord.stop()
+    for w in servers.values():
+        await w.stop()
+
+
 if __name__ == "__main__":
-    asyncio.run(main())
+    if "--coordinator" in sys.argv[1:]:
+        asyncio.run(main_coordinator())
+    else:
+        asyncio.run(main())

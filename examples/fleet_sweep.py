@@ -1,0 +1,492 @@
+"""Fleet sweep: goodput scaling of a coordinator-fronted worker fleet
+(ISSUE 10's measurement half), over real framed RPC on localhost.
+
+Four fake-fleet legs plus one real-engine leg, every one driving Poisson
+offered load through ``Coordinator.submit`` and checking token-exactness
+against the crc32-chain reference (the fake's next token is a pure
+function of the full context, so any worker — or any sequence of workers,
+after a failover — must produce the same stream):
+
+  replicated  N ∈ {1,2,4} decode workers as a pure replica set
+              (``deploy_model(register_shards=False)`` — LB spreading, not
+              registry sharding), offered load scaled with N and ~20% past
+              per-worker capacity, so the rows measure SUSTAINED goodput.
+              Acceptance: N=4 goodput ≥ 3.2x the N=1 row.
+  disagg      prefill pool + N decode workers via
+              ``deploy_model_disaggregated``: prefill handoffs cross the
+              wire as real ``PrefillHandoff`` frames; rows add handoff
+              bytes/s. Every result token-exact vs the single-engine
+              reference chain.
+  affinity    N=4 replicas with the fake's prefix-cache TTFT model on
+              (cold admission costs admit_latency_per_token_s per uncached
+              prompt token), same high-reuse workload twice: lb_strategy
+              least_connections (off) vs prefix_affinity (on). Rows carry
+              the LB's hit/miss/rebind counters and the measured TTFT
+              delta. Acceptance: hit-rate ≥ 90% and TTFT improves.
+  kill        N=4 under load, one worker hard-killed mid-run, supervisor
+              auto-respawns it (restart hook), retries+failover carry the
+              in-flight work. Acceptance: ≥ 99% of requests token-exact.
+  tiny        llama-tiny (real jax engines, CPU-friendly): 1 prefill + 1
+              decode worker disaggregated vs a plain continuous reference
+              worker, same seeded random-init weights (init key 0), same
+              prompts — the disagg path must be token-exact against the
+              single-engine answer THROUGH the coordinator.
+
+Knobs: BENCH_FLEET_* (read by bench.py — see its docstring) size the
+fleet and load; SWEEP_LEGS=replicated,disagg,... runs a subset. One JSON
+row per (leg, N) on stdout; per-leg BENCH_FLEET_<leg>.json files land in
+BENCH_FLEET_DIR (default bench_obs, "0" disables); a markdown table on
+stderr closes the run.
+
+    python examples/fleet_sweep.py
+    SWEEP_LEGS=replicated,affinity BENCH_FLEET_REQUESTS=80 \
+        python examples/fleet_sweep.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402  (repo-root bench.py: knobs + pct/log helpers)
+from bench import log, pct  # noqa: E402
+from distributed_inference_engine_tpu.api.coordinator import (  # noqa: E402
+    Coordinator, CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import (  # noqa: E402
+    WorkerServer,
+)
+from distributed_inference_engine_tpu.config import (  # noqa: E402
+    HealthConfig, ModelConfig, ServerConfig,
+)
+from distributed_inference_engine_tpu.models.fake import _chain  # noqa: E402
+
+VOCAB = 997
+STEP_S = bench.FLEET_STEP_MS / 1e3
+
+
+def expected_tokens(prompt, n):
+    st = 0
+    for t in prompt:
+        st = _chain(st, t)
+    out = []
+    for _ in range(n):
+        nxt = st % VOCAB
+        st = _chain(st, nxt)
+        out.append(nxt)
+    return out
+
+
+def fake_cfg(**meta) -> ModelConfig:
+    md = {"continuous": 1, "max_slots": bench.FLEET_SLOTS,
+          "step_latency_s": STEP_S}
+    md.update(meta)
+    return ModelConfig(name="m", architecture="fake", metadata=md)
+
+
+async def start_fleet(n_workers, *, coord_cfg=None, prefix="w"):
+    coord = Coordinator(coord_cfg or CoordinatorConfig(
+        retry_seed=bench.FLEET_SEED, retry_backoff_base_s=0.01))
+    await coord.start()
+    workers = {}
+    for i in range(n_workers):
+        wid = f"{prefix}{i}"
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=wid))
+        host, port = await w.start()
+        workers[wid] = w
+        coord.add_worker(wid, host, port)
+    return coord, workers
+
+
+async def stop_fleet(coord, workers):
+    await coord.stop()
+    for w in workers.values():
+        try:
+            await w.stop()
+        except Exception:
+            pass
+
+
+async def worker_generated(coord, model="m"):
+    """Per-worker generated-token counters (worker metrics RPC)."""
+    out = {}
+    for wid in list(coord.router.workers):
+        try:
+            m = await coord.router.client_for(wid).metrics()
+        except Exception:
+            continue
+        eng = m.get("models", {}).get(model, {})
+        out[wid] = {
+            "generated": int(eng.get("total_generated_tokens", 0)),
+            "handoff_bytes": int(m.get("handoff_bytes_shipped", 0)),
+        }
+    return out
+
+
+async def drive(coord, prompts, rate, new_tokens, seed, model="m",
+                mid_load_hook=None):
+    """Poisson arrivals at ``rate`` req/s; returns (results, wall_s,
+    ttfts, itls) with results aligned to ``prompts``. ``mid_load_hook``
+    (an async callable) fires once ~a third of the way into the arrival
+    schedule — the kill leg's sabotage slot."""
+    rs = np.random.RandomState(seed)
+    tasks = []
+    fire_at = len(prompts) // 3
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        tasks.append(asyncio.ensure_future(coord.submit(
+            model, prompt=p, max_new_tokens=new_tokens,
+            request_id=f"r{i}", no_cache=True)))
+        if mid_load_hook is not None and i == fire_at:
+            await mid_load_hook()
+            mid_load_hook = None
+        await asyncio.sleep(float(rs.exponential(1.0 / rate)))
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    wall = time.perf_counter() - t0
+    ttfts, itls = [], []
+    for r in results:
+        if isinstance(r, dict):
+            ttfts.append(float(r.get("ttft_s", 0.0)))
+            n = len(r.get("tokens", ()))
+            if n > 1:
+                itls.append(float(r.get("decode_s", 0.0)) / n)
+    return results, wall, ttfts, itls
+
+
+def score(prompts, results, new_tokens):
+    ok, toks = 0, 0
+    for p, r in zip(prompts, results):
+        if isinstance(r, dict):
+            toks += len(r.get("tokens", ()))
+            if r.get("tokens") == expected_tokens(p, new_tokens):
+                ok += 1
+    return ok, toks
+
+
+def row_base(leg, n, wall, prompts, results, ttfts, itls, new_tokens,
+             rate, gen0, gen1):
+    ok, toks = score(prompts, results, new_tokens)
+    per_worker = {
+        wid: round((gen1[wid]["generated"]
+                    - gen0.get(wid, {"generated": 0})["generated"]) / wall, 1)
+        for wid in gen1}
+    return {
+        "leg": leg, "workers": n, "requests": len(prompts),
+        "offered_req_s": round(rate, 1),
+        "goodput_toks": round(toks / wall, 1),
+        "token_exact": ok,
+        "token_exact_frac": round(ok / max(1, len(prompts)), 4),
+        "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 1),
+        "ttft_mean_ms": round(1e3 * sum(ttfts) / max(1, len(ttfts)), 1),
+        "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
+        "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
+        "per_worker_goodput": per_worker,
+        "wall_s": round(wall, 2),
+    }
+
+
+def emit(row):
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def dump_leg(leg, rows):
+    if bench.FLEET_DIR in ("0", ""):
+        return
+    os.makedirs(bench.FLEET_DIR, exist_ok=True)
+    path = os.path.join(bench.FLEET_DIR, f"BENCH_FLEET_{leg}.json")
+    with open(path, "w") as f:
+        json.dump({"leg": leg, "rows": rows}, f, indent=1)
+    log(f"  wrote {path}")
+
+
+def prompts_unique(n, seed, length=3):
+    rs = np.random.RandomState(seed)
+    return [[int(rs.randint(1, VOCAB)) for _ in range(length - 1)] + [i]
+            for i in range(n)]
+
+
+async def leg_replicated():
+    rows = []
+    for n in bench.FLEET_NS:
+        coord, workers = await start_fleet(n)
+        await coord.deploy_model(fake_cfg(), register_shards=False)
+        n_req = bench.FLEET_REQUESTS * n
+        rate = bench.FLEET_RATE * n
+        prompts = prompts_unique(n_req, bench.FLEET_SEED + n)
+        gen0 = await worker_generated(coord)
+        results, wall, ttfts, itls = await drive(
+            coord, prompts, rate, bench.FLEET_NEW_TOKENS,
+            bench.FLEET_SEED + n)
+        gen1 = await worker_generated(coord)
+        rows.append(emit(row_base("replicated", n, wall, prompts, results,
+                                  ttfts, itls, bench.FLEET_NEW_TOKENS,
+                                  rate, gen0, gen1)))
+        await stop_fleet(coord, workers)
+    by_n = {r["workers"]: r["goodput_toks"] for r in rows}
+    if 1 in by_n and 4 in by_n and by_n[1]:
+        scaling = by_n[4] / by_n[1]
+        log(f"  replicated scaling N=4 vs N=1: {scaling:.2f}x "
+            f"(acceptance >= 3.2x)")
+        rows.append(emit({"leg": "replicated", "summary": True,
+                          "scaling_n4_vs_n1": round(scaling, 2)}))
+    dump_leg("replicated", rows)
+    return rows
+
+
+async def leg_disagg():
+    rows = []
+    for n in bench.FLEET_NS:
+        n_prefill = 1 if n < 4 else 2
+        coord, workers = await start_fleet(0)
+        for i in range(n_prefill):
+            wid = f"p{i}"
+            w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                          worker_id=wid))
+            host, port = await w.start()
+            workers[wid] = w
+            coord.add_worker(wid, host, port)
+        for i in range(n):
+            wid = f"d{i}"
+            w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                          worker_id=wid))
+            host, port = await w.start()
+            workers[wid] = w
+            coord.add_worker(wid, host, port)
+        await coord.deploy_model_disaggregated(
+            fake_cfg(), [f"p{i}" for i in range(n_prefill)],
+            [f"d{i}" for i in range(n)])
+        n_req = bench.FLEET_REQUESTS * n
+        rate = bench.FLEET_RATE * n
+        # longer prompts than the replicated leg so the handoff KV is a
+        # real payload (64 B/token on the fake's placeholder KV)
+        prompts = prompts_unique(n_req, bench.FLEET_SEED + 10 * n, length=16)
+        gen0 = await worker_generated(coord)
+        results, wall, ttfts, itls = await drive(
+            coord, prompts, rate, bench.FLEET_NEW_TOKENS,
+            bench.FLEET_SEED + 10 * n)
+        gen1 = await worker_generated(coord)
+        row = row_base("disagg", n, wall, prompts, results, ttfts, itls,
+                       bench.FLEET_NEW_TOKENS, rate, gen0, gen1)
+        hb = sum(gen1[w]["handoff_bytes"]
+                 - gen0.get(w, {"handoff_bytes": 0})["handoff_bytes"]
+                 for w in gen1 if w.startswith("p"))
+        row["prefill_workers"] = n_prefill
+        row["handoff_bytes"] = hb
+        row["handoff_bytes_per_s"] = round(hb / wall, 1)
+        rows.append(emit(row))
+        await stop_fleet(coord, workers)
+    dump_leg("disagg", rows)
+    return rows
+
+
+def _affinity_prompts(n_prefixes, per_prefix, prefix_len, seed):
+    rs = np.random.RandomState(seed)
+    prefixes = [[int(rs.randint(1, VOCAB)) for _ in range(prefix_len)]
+                for _ in range(n_prefixes)]
+    prompts = [prefixes[i] + [i, j]
+               for i in range(n_prefixes) for j in range(per_prefix)]
+    rs.shuffle(prompts)
+    return prompts
+
+
+async def leg_affinity():
+    n = 4
+    page = 64
+    cfg = fake_cfg(prefix_cache=1, prefix_page_size=page,
+                   admit_latency_per_token_s=5e-4)
+    prompts = _affinity_prompts(12, 20, 2 * page, bench.FLEET_SEED)
+    # moderate utilisation (~40%) so TTFT reflects admission cost, not
+    # queueing noise — the cold/warm admission delta is what this leg is
+    # isolating
+    rate = 0.4 * bench.FLEET_SLOTS / STEP_S / bench.FLEET_NEW_TOKENS * n
+    rows = []
+    for mode, strategy in (("off", "least_connections"),
+                           ("on", "prefix_affinity")):
+        coord, workers = await start_fleet(n, coord_cfg=CoordinatorConfig(
+            lb_strategy=strategy, affinity_page_size=page, affinity_pages=2,
+            retry_seed=bench.FLEET_SEED, retry_backoff_base_s=0.01))
+        await coord.deploy_model(cfg, register_shards=False)
+        gen0 = await worker_generated(coord)
+        results, wall, ttfts, itls = await drive(
+            coord, prompts, rate, bench.FLEET_NEW_TOKENS, bench.FLEET_SEED)
+        gen1 = await worker_generated(coord)
+        row = row_base(f"affinity_{mode}", n, wall, prompts, results,
+                       ttfts, itls, bench.FLEET_NEW_TOKENS, rate,
+                       gen0, gen1)
+        lb = coord.lb.get_all_stats()
+        hits = lb.get("affinity_hits", 0)
+        misses = lb.get("affinity_misses", 0)
+        row["affinity_hits"] = hits
+        row["affinity_misses"] = misses
+        row["affinity_rebinds"] = lb.get("affinity_rebinds", 0)
+        row["affinity_hit_rate"] = round(
+            hits / max(1, hits + misses), 4)
+        rows.append(emit(row))
+        await stop_fleet(coord, workers)
+    off, on = rows
+    delta = off["ttft_mean_ms"] - on["ttft_mean_ms"]
+    log(f"  affinity: hit-rate {on['affinity_hit_rate']:.1%} "
+        f"(acceptance >= 90%), TTFT mean {off['ttft_mean_ms']:.1f} -> "
+        f"{on['ttft_mean_ms']:.1f} ms ({delta:+.1f} ms improvement)")
+    rows.append(emit({"leg": "affinity", "summary": True,
+                      "hit_rate": on["affinity_hit_rate"],
+                      "ttft_mean_improvement_ms": round(delta, 1)}))
+    dump_leg("affinity", rows)
+    return rows
+
+
+async def leg_kill():
+    n = 4
+    coord_cfg = CoordinatorConfig(
+        retry_seed=bench.FLEET_SEED, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1)
+    coord, workers = await start_fleet(n, coord_cfg=coord_cfg)
+    cfg = fake_cfg()
+    spawned = []
+
+    async def restart_hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+
+    coord.start_supervisor(restart_hook)
+    await coord.deploy_model(cfg)
+
+    async def sabotage():
+        victim = f"w{n - 1}"
+        log(f"  !! hard-killing {victim} mid-load (supervisor respawns)")
+        await workers.pop(victim).stop()
+
+    n_req = bench.FLEET_REQUESTS * n
+    rate = 0.8 * bench.FLEET_RATE * n
+    prompts = prompts_unique(n_req, bench.FLEET_SEED + 77)
+    gen0 = await worker_generated(coord)
+    results, wall, ttfts, itls = await drive(
+        coord, prompts, rate, bench.FLEET_NEW_TOKENS,
+        bench.FLEET_SEED + 77, mid_load_hook=sabotage)
+    for _ in range(100):
+        if coord.get_stats()["supervisor_respawns"] >= 1:
+            break
+        await asyncio.sleep(0.05)
+    gen1 = await worker_generated(coord)
+    stats = coord.get_stats()
+    row = row_base("kill", n, wall, prompts, results, ttfts, itls,
+                   bench.FLEET_NEW_TOKENS, rate, gen0, gen1)
+    row["supervisor_respawns"] = stats["supervisor_respawns"]
+    row["dispatch_retries"] = stats["dispatch_retries"]
+    log(f"  kill leg: {row['token_exact']}/{n_req} token-exact "
+        f"({row['token_exact_frac']:.1%}, acceptance >= 99%), "
+        f"respawns={row['supervisor_respawns']}")
+    rows = [emit(row)]
+    await stop_fleet(coord, workers)
+    for w in spawned:
+        try:
+            await w.stop()
+        except Exception:
+            pass
+    dump_leg("kill", rows)
+    return rows
+
+
+async def leg_tiny():
+    """Real-engine leg: llama-tiny disaggregated through the coordinator
+    must match a plain single-engine worker token-for-token (both engines
+    random-init from the same fixed key, so their logits agree)."""
+    base = dict(architecture="llama-tiny", max_seq_len=128,
+                max_batch_size=4)
+    cfg = ModelConfig(name="tiny", metadata={"continuous": 1,
+                                             "max_slots": 2}, **base)
+    ref_cfg = ModelConfig(name="tiny_ref", metadata={"continuous": 1,
+                                                     "max_slots": 2}, **base)
+    coord, workers = await start_fleet(0)
+    for wid in ("tp0", "td0", "ref0"):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=wid))
+        host, port = await w.start()
+        workers[wid] = w
+        coord.add_worker(wid, host, port)
+    t0 = time.perf_counter()
+    await coord.deploy_model_disaggregated(cfg, ["tp0"], ["td0"])
+    await coord.deploy_model(ref_cfg, worker_ids=["ref0"])
+    log(f"  tiny: engines up in {time.perf_counter() - t0:.1f}s")
+    rs = np.random.RandomState(bench.FLEET_SEED)
+    prompts = [[int(rs.randint(1, 96)) for _ in range(16)]
+               for _ in range(4)]
+    exact = 0
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        got = await coord.submit("tiny", prompt=p, max_new_tokens=8,
+                                 request_id=f"t{i}", no_cache=True)
+        ref = await coord.submit("tiny_ref", prompt=p, max_new_tokens=8,
+                                 request_id=f"tr{i}", no_cache=True)
+        if got["tokens"] == ref["tokens"]:
+            exact += 1
+        else:
+            log(f"  tiny MISMATCH req {i}: disagg={got['tokens']} "
+                f"ref={ref['tokens']}")
+    wall = time.perf_counter() - t0
+    m = await coord.router.client_for("tp0").metrics()
+    row = {"leg": "tiny", "workers": 2, "requests": len(prompts),
+           "token_exact": exact,
+           "token_exact_frac": round(exact / len(prompts), 4),
+           "handoff_bytes": int(m.get("handoff_bytes_shipped", 0)),
+           "wall_s": round(wall, 2)}
+    log(f"  tiny: {exact}/{len(prompts)} token-exact vs single-engine "
+        f"reference, {row['handoff_bytes']} handoff bytes")
+    rows = [emit(row)]
+    await stop_fleet(coord, workers)
+    dump_leg("tiny", rows)
+    return rows
+
+
+LEGS = {"replicated": leg_replicated, "disagg": leg_disagg,
+        "affinity": leg_affinity, "kill": leg_kill}
+
+
+async def main_async():
+    want = [s for s in os.environ.get(
+        "SWEEP_LEGS", "replicated,disagg,affinity,kill,tiny").split(",") if s]
+    all_rows = []
+    for name in want:
+        if name == "tiny":
+            if not bench.FLEET_TINY:
+                continue
+            log("=== leg: tiny (real llama-tiny engines) ===")
+            all_rows += await leg_tiny()
+            continue
+        fn = LEGS.get(name)
+        if fn is None:
+            log(f"unknown leg {name!r} — skipping")
+            continue
+        log(f"=== leg: {name} ===")
+        all_rows += await fn()
+    data_rows = [r for r in all_rows if not r.get("summary")]
+    log("\n| leg | N | goodput tok/s | token-exact | TTFT p50 | "
+        "TTFT p99 | ITL p50 | hit-rate | handoff B/s |")
+    log("|---|---|---|---|---|---|---|---|---|")
+    for r in data_rows:
+        log(f"| {r['leg']} | {r.get('workers', '-')} | "
+            f"{r.get('goodput_toks', '-')} | "
+            f"{r['token_exact']}/{r['requests']} | "
+            f"{r.get('ttft_p50_ms', '-')} | {r.get('ttft_p99_ms', '-')} | "
+            f"{r.get('itl_p50_ms', '-')} | "
+            f"{r.get('affinity_hit_rate', '-')} | "
+            f"{r.get('handoff_bytes_per_s', '-')} |")
+
+
+if __name__ == "__main__":
+    asyncio.run(main_async())

@@ -88,6 +88,19 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2e: fast fleet-serving leg ---------------------------------
+# prefix-affinity routing, disaggregated pools through the coordinator,
+# rebind on drain/respawn/stream-failover (-m fleet): a broken routing
+# or handoff path fails here before the full sweep.
+echo "== fleet serving (-m 'fleet and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'fleet and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: fleet serving leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.

@@ -65,6 +65,11 @@ def pytest_configure(config):
         "markers", "elastic: elastic worker lifecycle tests (serving "
         "artifact round-trip/corruption, supervisor respawn, crash-loop "
         "breaker; fast leg: pytest -m 'elastic and not slow')")
+    config.addinivalue_line(
+        "markers", "fleet: fleet-scale serving tests (prefix-affinity "
+        "routing, prefill/decode pools through the coordinator, affinity "
+        "rebind on drain/respawn/failover; fast leg: pytest -m 'fleet "
+        "and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

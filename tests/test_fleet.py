@@ -1,0 +1,379 @@
+"""Fleet-scale serving tests (-m fleet): prefix-affinity routing over a
+live multi-worker fake fleet, affinity rebind across drain / supervisor
+respawn / stream failover, and the disaggregated prefill+decode path
+through the coordinator.
+
+Same determinism discipline as the chaos suite: the fake continuous
+engine's next token is a crc32 chain over the FULL context, so whichever
+worker — or sequence of workers, after a rebind — serves a request, the
+output is checkable token-for-token. Replicated (non-sharded) deploys use
+``deploy_model(register_shards=False)``, the mode where the LOAD BALANCER
+(not the registry's consistent hashing) places every request and the
+``prefix_affinity`` strategy engages.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.api.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.load_balancer import (
+    LoadBalancer,
+    LoadBalancerStrategy,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+from distributed_inference_engine_tpu.config import (
+    HealthConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.models.fake import _chain
+
+pytestmark = pytest.mark.fleet
+
+VOCAB = 997
+
+
+def expected_tokens(prompt, n, vocab=VOCAB):
+    st = 0
+    for t in prompt:
+        st = _chain(st, t)
+    out = []
+    for _ in range(n):
+        nxt = st % vocab
+        st = _chain(st, nxt)
+        out.append(nxt)
+    return out
+
+
+PREFIX = [7, 7, 7, 7]           # one full affinity page (page_size=4)
+
+
+def prompt_with_tail(i):
+    return PREFIX + [100 + i]
+
+
+async def start_affinity_fleet(n_workers, strategy="prefix_affinity",
+                               model_meta=None, **coord_overrides):
+    """Coordinator with LB-placed (non-sharded) replicas of the fake."""
+    kw = dict(lb_strategy=strategy, affinity_page_size=4, affinity_pages=2,
+              retry_seed=7, retry_backoff_base_s=0.01)
+    kw.update(coord_overrides)
+    coord = Coordinator(CoordinatorConfig(**kw))
+    await coord.start()
+    meta = {"continuous": 1, "max_slots": 4}
+    meta.update(model_meta or {})
+    cfg = ModelConfig(name="m", architecture="fake", metadata=meta)
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    await coord.deploy_model(cfg, register_shards=False)
+    return coord, workers, cfg
+
+
+async def stop_fleet(coord, workers):
+    await coord.stop()
+    for w in workers.values():
+        try:
+            await w.stop()
+        except Exception:
+            pass
+
+
+async def served_counts(coord, workers):
+    """Per-worker request counts from each live worker's engine metrics."""
+    out = {}
+    for wid in workers:
+        if wid not in coord.router.workers:
+            continue
+        m = await coord.router.client_for(wid).metrics()
+        out[wid] = int(m["models"]["m"]["total_requests"])
+    return out
+
+
+# ----------------------------------------------------- affinity placement
+
+async def test_same_prefix_lands_on_same_worker():
+    """Every same-prefix request must land on the one worker whose cache
+    is warm; the LB's hit/miss counters must account for each pick."""
+    coord, workers, _ = await start_affinity_fleet(4)
+    try:
+        n = 10
+        for i in range(n):
+            r = await coord.submit("m", prompt=prompt_with_tail(i),
+                                   max_new_tokens=6, no_cache=True)
+            assert r["tokens"] == expected_tokens(prompt_with_tail(i), 6)
+        counts = await served_counts(coord, workers)
+        hot = [wid for wid, c in counts.items() if c]
+        assert hot == [hot[0]] * len(hot) and counts[hot[0]] == n, \
+            f"same-prefix requests scattered: {counts}"
+        lb = coord.lb.get_all_stats()
+        assert lb["affinity_misses"] == 1          # first sight binds
+        assert lb["affinity_hits"] == n - 1        # the rest ride it
+        assert lb["affinity_bindings"] == 1
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_distinct_prefixes_get_distinct_bindings():
+    """Cold prefixes fall back to least-connections — concurrent distinct
+    prefixes spread instead of piling onto one replica."""
+    coord, workers, _ = await start_affinity_fleet(4)
+    try:
+        prompts = [[p, p, p, p, 9] for p in range(1, 9)]
+        results = await asyncio.gather(*[
+            coord.submit("m", prompt=p, max_new_tokens=6, no_cache=True)
+            for p in prompts])
+        for p, r in zip(prompts, results):
+            assert r["tokens"] == expected_tokens(p, 6)
+        lb = coord.lb.get_all_stats()
+        assert lb["affinity_bindings"] == len(prompts)
+        bound_workers = set(coord.lb._affinity.values())
+        assert len(bound_workers) > 1, \
+            "8 cold prefixes all bound to one worker"
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_short_prompt_has_no_affinity_key():
+    """Prompts shorter than one affinity page carry no key and spread
+    via the keyless fallback — no binding-table pollution."""
+    coord, workers, _ = await start_affinity_fleet(2)
+    try:
+        for i in range(4):
+            r = await coord.submit("m", prompt=[i], max_new_tokens=4,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens([i], 4)
+        assert coord.lb.get_all_stats()["affinity_bindings"] == 0
+    finally:
+        await stop_fleet(coord, workers)
+
+
+# --------------------------------------------------------- rebind: drain
+
+async def test_affinity_rebinds_after_drain_without_drops():
+    """Draining the bound worker must invalidate its bindings; follow-up
+    same-prefix traffic rebinds to a survivor and stays token-exact."""
+    coord, workers, _ = await start_affinity_fleet(3)
+    try:
+        for i in range(4):
+            await coord.submit("m", prompt=prompt_with_tail(i),
+                               max_new_tokens=6, no_cache=True)
+        bound = next(iter(coord.lb._affinity.values()))
+        await coord.drain_worker(bound)
+        assert bound not in coord.lb._affinity.values(), \
+            "drain must drop the drained worker's bindings"
+        rebinds0 = coord.lb.get_all_stats()["affinity_rebinds"]
+        assert rebinds0 >= 1
+        for i in range(4, 10):
+            p = prompt_with_tail(i)
+            r = await coord.submit("m", prompt=p, max_new_tokens=6,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens(p, 6)
+        rebound = next(iter(coord.lb._affinity.values()))
+        assert rebound != bound
+        counts = await served_counts(coord, workers)
+        assert counts[rebound] >= 6
+    finally:
+        await stop_fleet(coord, workers)
+
+
+# ----------------------------------------- rebind: supervisor kill/respawn
+
+async def test_affinity_rebinds_after_supervisor_respawn():
+    """Hard-kill the bound worker mid-load with the supervisor on: every
+    request still completes token-exact (retry + failover), the stale
+    binding is invalidated, and the respawned worker rejoins the fleet."""
+    coord, workers, cfg = await start_affinity_fleet(
+        2, model_meta={"step_latency_s": 0.005},
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1)
+    spawned = []
+
+    async def restart_hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+
+    coord.start_supervisor(restart_hook)
+    try:
+        r = await coord.submit("m", prompt=prompt_with_tail(0),
+                               max_new_tokens=6, no_cache=True)
+        assert r["tokens"] == expected_tokens(prompt_with_tail(0), 6)
+        bound = next(iter(coord.lb._affinity.values()))
+
+        prompts = [prompt_with_tail(1 + i) for i in range(12)]
+        tasks = [asyncio.ensure_future(
+            coord.submit("m", prompt=p, max_new_tokens=8, no_cache=True))
+            for p in prompts]
+        await asyncio.sleep(0.05)
+        await workers.pop(bound).stop()
+
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        ok = sum(1 for p, r in zip(prompts, results)
+                 if isinstance(r, dict)
+                 and r["tokens"] == expected_tokens(p, 8))
+        assert ok == len(prompts), \
+            f"dropped requests across respawn: {ok}/{len(prompts)}"
+        assert bound not in coord.lb._affinity.values()
+        # the supervisor may still be mid-respawn; wait for it
+        for _ in range(100):
+            if coord.get_stats()["supervisor_respawns"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert coord.get_stats()["supervisor_respawns"] >= 1
+    finally:
+        await stop_fleet(coord, workers)
+        for w in spawned:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+
+
+# ------------------------------------------- rebind: stream failover
+
+async def test_stream_failover_invalidates_stale_binding():
+    """Mid-stream kill of the bound worker: the replay resumes token-exact
+    on a survivor AND the dead worker's binding is invalidated, so the
+    next same-prefix request routes straight to a live replica."""
+    coord, workers, _ = await start_affinity_fleet(
+        2, model_meta={"step_latency_s": 0.01})
+    try:
+        got, killed = [], []
+
+        def on_tokens(toks):
+            got.append(list(toks))
+            if len(got) == 3 and not killed:
+                for wid, w in workers.items():
+                    if w._request_count:
+                        killed.append(wid)
+                        asyncio.ensure_future(w.stop())
+
+        prompt = PREFIX + [42]
+        r = await coord.submit_stream("m", prompt=prompt, max_new_tokens=20,
+                                      on_tokens=on_tokens)
+        exp = expected_tokens(prompt, 20)
+        assert killed, "the serving worker must have been killed mid-stream"
+        assert r["tokens"] == exp
+        assert [t for c in got for t in c] == exp
+        dead = killed[0]
+        assert dead not in coord.lb._affinity.values(), \
+            "stream failover must invalidate the stale binding"
+        assert coord.lb.get_all_stats()["affinity_rebinds"] >= 1
+        # follow-up same-prefix request completes on a live replica (the
+        # LB's own health view may lag the kill, so a dispatch retry is
+        # permitted — what matters is the stale binding is gone)
+        r2 = await coord.submit("m", prompt=PREFIX + [43], max_new_tokens=6,
+                                no_cache=True)
+        assert r2["tokens"] == expected_tokens(PREFIX + [43], 6)
+        assert dead not in coord.lb._affinity.values()
+        # once the key settles on a live worker, it stays there
+        for i in (44, 45):
+            r3 = await coord.submit("m", prompt=PREFIX + [i],
+                                    max_new_tokens=6, no_cache=True)
+            assert r3["tokens"] == expected_tokens(PREFIX + [i], 6)
+        survivors = set(coord.lb._affinity.values())
+        assert survivors and dead not in survivors
+    finally:
+        await stop_fleet(coord, workers)
+
+
+# ------------------------------------- disaggregated pools via coordinator
+
+async def test_disagg_pools_token_exact_through_coordinator():
+    """Prefill pool + decode pool over real framed RPC: results must be
+    chain-exact (first token from the handoff, continuation decode-side),
+    the prefill pool must actually ship KV bytes, and worker roles must
+    be visible in coordinator stats."""
+    coord = Coordinator(CoordinatorConfig(retry_seed=7,
+                                          retry_backoff_base_s=0.01))
+    await coord.start()
+    cfg = ModelConfig(name="m", architecture="fake",
+                      metadata={"continuous": 1, "max_slots": 4})
+    workers = {}
+    for wid in ("p0", "d0", "d1"):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=wid))
+        host, port = await w.start()
+        workers[wid] = w
+        coord.add_worker(wid, host, port)
+    try:
+        n_pre, n_dec = await coord.deploy_model_disaggregated(
+            cfg, ["p0"], ["d0", "d1"])
+        assert (n_pre, n_dec) == (1, 2)
+        roles = coord.get_stats()["worker_roles"]
+        assert roles == {"p0": "prefill", "d0": "decode", "d1": "decode"}
+
+        prompts = [[200 + i, i % 5, 3, 8] for i in range(8)]
+        results = await asyncio.gather(*[
+            coord.submit("m", prompt=p, max_new_tokens=8, no_cache=True)
+            for p in prompts])
+        for p, r in zip(prompts, results):
+            assert r["tokens"] == expected_tokens(p, 8)
+            assert r["metadata"]["prefill_worker"] == "p0"
+            assert r["metadata"]["decode_worker"] in ("d0", "d1")
+        m = await coord.router.client_for("p0").metrics()
+        assert m["handoff_bytes_shipped"] > 0
+        assert m["models"]["m"]["role"] == "prefill"
+    finally:
+        await stop_fleet(coord, workers)
+
+
+# --------------------------------------------------- LB unit-level checks
+
+def _lb(strategy=LoadBalancerStrategy.PREFIX_AFFINITY, capacity=4096):
+    lb = LoadBalancer(strategy=strategy, affinity_capacity=capacity)
+    for i in range(3):
+        lb.register_worker(f"w{i}", "127.0.0.1", 9000 + i)
+    return lb
+
+
+def test_lb_affinity_hit_miss_rebind_counters():
+    lb = _lb()
+    first = lb.get_worker(affinity="k1")
+    assert lb.get_worker(affinity="k1").worker_id == first.worker_id
+    stats = lb.get_all_stats()
+    assert (stats["affinity_misses"], stats["affinity_hits"]) == (1, 1)
+    lb.unregister_worker(first.worker_id)
+    again = lb.get_worker(affinity="k1")
+    assert again.worker_id != first.worker_id
+    stats = lb.get_all_stats()
+    # one rebind from the invalidation; the re-pick is a fresh miss
+    assert stats["affinity_rebinds"] == 1
+    assert stats["affinity_misses"] == 2
+
+
+def test_lb_affinity_lru_capacity():
+    lb = _lb(capacity=2)
+    for k in ("a", "b", "c"):
+        lb.get_worker(affinity=k)
+    stats = lb.get_all_stats()
+    assert stats["affinity_bindings"] == 2
+    assert "a" not in lb._affinity and "c" in lb._affinity
+
+
+def test_lb_affinity_quarantine_invalidates():
+    lb = _lb()
+    s = lb.get_worker(affinity="k")
+    lb.quarantine(s.worker_id)
+    assert s.worker_id not in lb._affinity.values()
+    assert lb.get_all_stats()["affinity_rebinds"] == 1
+
+
+def test_lb_keyless_requests_fall_back():
+    lb = _lb()
+    picks = {lb.get_worker().worker_id for _ in range(6)}
+    assert len(picks) >= 1              # keyless path stays functional
+    assert lb.get_all_stats()["affinity_bindings"] == 0
