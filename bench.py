@@ -84,12 +84,21 @@ Env knobs:
                    latency, default 5), BENCH_FLEET_SLOTS (fake decode
                    slots, default 8), BENCH_FLEET_SEED (arrivals + retry
                    jitter, default 1234), BENCH_FLEET_TINY (1 = run the
-                   llama-tiny disaggregated token-exactness leg, default 1)
+                   llama-tiny disaggregated token-exactness leg, default 1),
+                   BENCH_FLEET_MIN (autoscale leg min fleet, default 1),
+                   BENCH_FLEET_MAX (autoscale leg max fleet, default 3),
+                   BENCH_FLEET_BURST (autoscale leg mid-run load
+                   multiplier vs one worker's capacity, default 3.5 —
+                   keep it ABOVE BENCH_FLEET_MAX so the burst saturates
+                   even the full fleet: every smaller fleet is clearly
+                   insufficient and the full one never reads as idle
+                   mid-burst, which keeps the decision sequence
+                   replay-stable)
     The sweep's non-BENCH knobs (SWEEP_* family, shared naming with
     examples/serving_sweep.py): serving_sweep reads SWEEP_RATES /
     SWEEP_REQUESTS / SWEEP_TRIALS / SWEEP_SHAPE; fleet_sweep reads
     SWEEP_LEGS (comma list to run a subset of
-    replicated,disagg,affinity,kill,tiny).
+    replicated,disagg,affinity,kill,autoscale,upgrade,tiny).
 """
 
 import json
@@ -192,6 +201,9 @@ FLEET_STEP_MS = float(os.environ.get("BENCH_FLEET_STEP_MS", "5"))
 FLEET_SLOTS = int(os.environ.get("BENCH_FLEET_SLOTS", "8"))
 FLEET_SEED = int(os.environ.get("BENCH_FLEET_SEED", "1234"))
 FLEET_TINY = os.environ.get("BENCH_FLEET_TINY", "1") not in ("0", "")
+FLEET_MIN = int(os.environ.get("BENCH_FLEET_MIN", "1"))
+FLEET_MAX = int(os.environ.get("BENCH_FLEET_MAX", "3"))
+FLEET_BURST = float(os.environ.get("BENCH_FLEET_BURST", "3.5"))
 
 
 def _probe_tpu(timeout_s: float = 120.0) -> bool:

@@ -185,6 +185,13 @@ class Coordinator:
         self._stream_resumes = 0        # mid-stream failovers with replay
         self._deadline_expired = 0      # client-visible deadline outcomes
         self._drains = 0                # graceful worker drains completed
+        # fleet-level graceful degradation (set_admission_shed): when the
+        # autoscaler is at max fleet and still SLO-violating, requests are
+        # refused AT ADMISSION with the typed overloaded outcome + a
+        # retry-after hint, instead of queueing into a fleet that cannot
+        # absorb them
+        self._admission_shed: Optional[Dict[str, Any]] = None
+        self._admission_sheds = 0       # requests refused by fleet shed
         # supervisor loop state (start_supervisor arms it)
         self._restart_hook = None
         self._supervisor_task: Optional[asyncio.Task] = None
@@ -273,6 +280,33 @@ class Coordinator:
             self.remove_worker(worker_id)
         return summary
 
+    # -- fleet-level graceful degradation -----------------------------------
+
+    def set_admission_shed(self, active: bool,
+                           reason: str = "fleet_overloaded",
+                           retry_after_s: float = 1.0) -> None:
+        """Engage/disengage fleet-level admission shedding. While active,
+        ``submit``/``submit_stream`` raise the typed ``overloaded`` outcome
+        (with ``retry_after_s`` as the client backoff hint) instead of
+        dispatching — the autoscaler flips this on when the fleet is at
+        ``max_workers`` and still SLO-violating, and off once pressure
+        clears. Cache hits are still served: they cost no engine steps."""
+        if active:
+            self._admission_shed = {"reason": reason,
+                                    "retry_after_s": float(retry_after_s)}
+        else:
+            self._admission_shed = None
+
+    def _check_admission(self, request_id: str) -> None:
+        shed = self._admission_shed
+        if shed is None:
+            return
+        self._admission_sheds += 1
+        raise EngineOverloadedError(
+            f"request {request_id} shed at admission: fleet at max size "
+            f"and SLO-violating; retry after {shed['retry_after_s']:.2f}s",
+            reason=shed["reason"], retry_after_s=shed["retry_after_s"])
+
     # -- supervisor: auto-respawn dead workers ------------------------------
 
     def start_supervisor(self, restart_hook) -> None:
@@ -302,6 +336,16 @@ class Coordinator:
                 await task
             except asyncio.CancelledError:
                 pass
+
+    def respawns_in_flight(self) -> int:
+        """Workers the supervisor is (or is about to be) fighting for:
+        respawn attempts in flight plus routers-declared-UNHEALTHY workers
+        awaiting a sweep. The autoscaler holds while this is non-zero —
+        replacing capacity is the supervisor's job, not a load signal."""
+        n = sum(1 for st in self._supervised.values() if st.respawning)
+        n += sum(1 for info in self.router.workers.values()
+                 if info.health is WorkerHealth.UNHEALTHY)
+        return n
 
     def supervisor_reset(self, worker_id: str) -> bool:
         """Operator re-arm after a crash-loop open (e.g. the artifact was
@@ -654,6 +698,9 @@ class Coordinator:
                     out["text"] = tokenizer.decode(out.get("tokens", []))
                 return out
 
+        # fleet-level degradation gate sits AFTER the cache lookup (hits
+        # cost no engine steps) and BEFORE any dispatch work
+        self._check_admission(request_id)
         inputs = {
             "prompt": list(prompt),
             "max_new_tokens": max_new_tokens,
@@ -765,6 +812,9 @@ class Coordinator:
             self._prefix_affinity_key(prompt)
         trace = RequestTrace(request_id=request_id)
         trace.mark("received")
+        # streams bypass the cache, so the degradation gate is the first
+        # stop after admission bookkeeping
+        self._check_admission(request_id)
 
         route_key = affinity if affinity is not None else request_id
         sharded = bool(self.registry.all_shards(model, version))
@@ -1522,7 +1572,16 @@ class Coordinator:
 
     def _obs_collect(self) -> None:
         """Scrape-time collector: rebuild worker-labelled series from the
-        last fleet poll, then mirror this process's stats dicts."""
+        last fleet poll, then mirror this process's stats dicts.
+
+        The poll cache is pruned against CURRENT membership first: a
+        worker unregistered since the last refresh must drop out of the
+        exposition at the next scrape, not linger as ghost series until
+        someone happens to scrape with ``refresh_workers=True``."""
+        live = set(self.router.workers) | set(self.lb.workers)
+        self._worker_metrics = {wid: wm
+                                for wid, wm in self._worker_metrics.items()
+                                if wid in live}
         obs_collectors.clear_worker_labelled(self.obs_registry)
         obs_collectors.apply_coordinator(self.obs_registry, self.get_stats())
         for wid, wm in self._worker_metrics.items():
@@ -1565,6 +1624,8 @@ class Coordinator:
             "stream_resumes": self._stream_resumes,
             "deadline_expired": self._deadline_expired,
             "drains": self._drains,
+            "admission_sheds": self._admission_sheds,
+            "admission_shed_active": 1 if self._admission_shed else 0,
             "supervisor_respawns": self._supervisor_respawns,
             "supervisor_crashloop_opens": self._supervisor_crashloop_opens,
             "supervisor": {

@@ -318,6 +318,46 @@ class ServerConfig:
 
 
 @dataclass
+class AutoscalerConfig:
+    """SLO-driven fleet sizing (cluster/autoscaler.py): the policy loop
+    compares scrape-time TTFT/ITL percentiles and queue depth against
+    these targets and grows/shrinks the replica set between
+    ``min_workers`` and ``max_workers``. All decision state is tick-based
+    (no wall-clock branches), so same-seed runs replay to an identical
+    decision ledger."""
+
+    # SLO targets: a dimension with target <= 0 is not enforced
+    ttft_p95_target_s: float = 0.5
+    itl_p95_target_s: float = 0.0
+    queue_depth_target: float = 8.0   # mean waiting requests per worker
+    # fleet bounds
+    min_workers: int = 1
+    max_workers: int = 4
+    # hysteresis band on SLO attainment (1.0 = meeting every target):
+    # below scale_up_attainment pressure is a breach; scale-down needs
+    # attainment at scale_down_attainment AND queue drained below
+    # scale_down_queue_frac * queue_depth_target. Between the bands the
+    # policy holds.
+    scale_up_attainment: float = 0.85
+    scale_down_attainment: float = 1.0
+    scale_down_queue_frac: float = 0.25
+    # debounce: consecutive breach/clear ticks required before acting
+    breach_ticks: int = 2
+    clear_ticks: int = 4
+    # cooldown windows (ticks) after a scale action before the next one
+    cooldown_up_ticks: int = 3
+    cooldown_down_ticks: int = 6
+    # fleet-level graceful degradation: at max fleet and still breaching
+    # for shed_ticks consecutive ticks, the coordinator sheds at
+    # admission with the typed overloaded outcome + this retry-after hint
+    shed_ticks: int = 4
+    shed_retry_after_s: float = 1.0
+    # policy loop cadence and victim tie-break seed
+    interval_s: float = 0.5
+    seed: int = 0
+
+
+@dataclass
 class MultihostConfig:
     """jax.distributed bootstrap for pod slices (parallel/multihost.py);
     empty/default fields mean Cloud-TPU env auto-discovery."""
@@ -341,6 +381,7 @@ class Config:
     health: HealthConfig = field(default_factory=HealthConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
     multihost: MultihostConfig = field(default_factory=MultihostConfig)
+    autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -358,6 +399,7 @@ def config_from_dict(d: Dict[str, Any]) -> Config:
         ("health", HealthConfig),
         ("server", ServerConfig),
         ("multihost", MultihostConfig),
+        ("autoscaler", AutoscalerConfig),
     ):
         if section in d:
             setattr(cfg, section, build_dataclass(cls, d[section]))

@@ -26,9 +26,16 @@ class EngineOverloadedError(RuntimeError):
 
     rpc_error_kind = OVERLOADED
 
-    def __init__(self, msg: str, reason: str = "queue_full") -> None:
+    def __init__(self, msg: str, reason: str = "queue_full",
+                 retry_after_s: Optional[float] = None) -> None:
         super().__init__(msg)
-        self.reason = reason    # "queue_full" | "deadline" | "draining"
+        # "queue_full" | "deadline" | "draining" | "fleet_overloaded"
+        self.reason = reason
+        # backoff hint for the caller: set by fleet-level admission
+        # shedding (the coordinator at max fleet and still SLO-violating);
+        # None for engine-local sheds, where "one alternate then error"
+        # already encodes the policy
+        self.retry_after_s = retry_after_s
         # rides the RPC error envelope as ``error_detail`` so remote
         # callers get the reason structurally, not by sniffing text
         self.rpc_error_detail = reason

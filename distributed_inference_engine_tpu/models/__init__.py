@@ -91,6 +91,7 @@ def engine_from_config(cfg):
                 max_waiting=int(cfg.metadata.get("max_waiting", 0)),
                 queue_deadline_s=float(
                     cfg.metadata.get("queue_deadline_s", 0.0)),
+                vocab_size=int(cfg.metadata.get("vocab_size", 997)),
                 admit_latency_per_token_s=float(
                     cfg.metadata.get("admit_latency_per_token_s", 0.0)),
                 prefix_cache=bool(cfg.metadata.get("prefix_cache", False)),

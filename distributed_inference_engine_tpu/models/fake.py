@@ -164,6 +164,13 @@ class FakeContinuousEngine:
         self._shed_deadline = 0
         self._deadline_expired = 0
         self._prefilled_admitted = 0
+        # served-request latency distributions, exported as the
+        # engine_ttft_seconds / engine_decode_chunk_seconds histogram
+        # families — the autoscaler's scrape-time SLO inputs. ttft covers
+        # queue wait + admission (recorded at first decode step for a
+        # slot); step_stats records per-step wall, the fake's ITL proxy.
+        self.ttft_stats = LatencyStats()
+        self.step_stats = LatencyStats()
 
     # ------------------------------------------------------------- submit
 
@@ -280,6 +287,7 @@ class FakeContinuousEngine:
                 self._total_generated += 1
                 if cb is not None:
                     cb([first])
+                self.ttft_stats.add(time.perf_counter() - t)
                 if (first == req.eos_id or first in (req.stop_ids or ())
                         or len(toks) >= req.max_new_tokens):
                     now0 = time.perf_counter()
@@ -294,13 +302,16 @@ class FakeContinuousEngine:
             self._live.append([req, cb, t, state, toks])
         if not self._live:
             return 0
+        t_step = time.perf_counter()
         if self.step_latency_s:
             time.sleep(self.step_latency_s)
         self._steps += 1
         now = time.perf_counter()
+        self.step_stats.add(now - t_step)
         still: List[list] = []
         for slot in self._live:
             req, cb, t, state, toks = slot
+            had_tokens = bool(toks)
             fresh: List[int] = []
             done = False
             for _ in range(self.tokens_per_step):
@@ -318,6 +329,8 @@ class FakeContinuousEngine:
             slot[3] = state
             if fresh and cb is not None:
                 cb(list(fresh))
+            if fresh and not had_tokens:
+                self.ttft_stats.add(now - t)
             if done:
                 stopped = bool(toks) and (
                     toks[-1] == req.eos_id or toks[-1] in (req.stop_ids or ()))
@@ -381,6 +394,8 @@ class FakeContinuousEngine:
             "prefilled_admitted": self._prefilled_admitted,
             "prefix_cached_tokens": self._prefix_cached_tokens,
             "admit_sleep_s": self._admit_sleep_s,
+            "ttft": self.ttft_stats.snapshot(),
+            "decode_chunk": self.step_stats.snapshot(),
             "spec": {"fake": True, "continuous": True},
         }
 

@@ -274,6 +274,35 @@ COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
      "Unhealthy workers respawned and re-admitted by the supervisor"),
     ("supervisor_crashloop_opens", "supervisor_crashloop_opens", "c",
      "Crash-loop breakers opened (worker given up on, shards FAILED)"),
+    ("admission_sheds", "coordinator_admission_sheds", "c",
+     "Requests shed at coordinator admission (fleet-level degradation)"),
+    ("admission_shed_active", "coordinator_admission_shed_active", "g",
+     "1 while fleet-level admission shedding is engaged"),
+]
+
+AUTOSCALER_TABLE = [               # FleetAutoscaler.get_stats()
+    ("fleet_size", "autoscaler_fleet_size", "g",
+     "Workers currently governed by the autoscaler"),
+    ("slo_attainment", "autoscaler_slo_attainment", "g",
+     "Latest SLO attainment (1.0 = every target met)"),
+    ("ticks", "autoscaler_ticks", "c", "Policy evaluations run"),
+    ("scale_ups", "autoscaler_scale_ups", "c",
+     "Scale-up actions (spawn + half-open rejoin)"),
+    ("scale_downs", "autoscaler_scale_downs", "c",
+     "Scale-down actions (graceful drain + remove)"),
+    ("guard_holds", "autoscaler_guard_holds", "c",
+     "Ticks held by the breaker/supervisor guard"),
+]
+
+UPGRADE_TABLE = [                  # RollingUpgrade.get_stats()
+    ("upgraded", "upgrade_workers", "c",
+     "Workers upgraded (drain, artifact swap, probe, half-open rejoin)"),
+    ("probe_failures", "upgrade_probe_failures", "c",
+     "Golden probes failed by a swapped-in worker"),
+    ("rollbacks", "upgrade_rollbacks", "c",
+     "Upgrades rolled back to the prior artifact after a failed probe"),
+    ("in_progress", "upgrade_in_progress", "g",
+     "1 while a rolling upgrade is running"),
 ]
 
 WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
@@ -316,6 +345,8 @@ EXTRA_FAMILIES = [
      "Worker process resident set size (psutil, 0 if unavailable)"),
     ("fleet_worker_role", "g", ("worker_id", "role"),
      "1 for the worker's fleet role: prefill / decode / replica"),
+    ("autoscaler_decisions", "c", ("action",),
+     "Scaling decisions by action: up / down / shed_on / shed_off"),
 ]
 
 _GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
@@ -332,6 +363,8 @@ _GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
     (REGISTRY_TABLE, ()),
     (COORDINATOR_TABLE, ()),
     (WORKER_TABLE, WORKER_LABELS),
+    (AUTOSCALER_TABLE, ()),
+    (UPGRADE_TABLE, ()),
 ]
 
 _KINDS = {"c": "counter", "g": "gauge", "h": "histogram"}
@@ -491,6 +524,28 @@ def apply_coordinator(reg: MetricsRegistry,
                         ("worker_id", "role"))
         for wid, role in roles.items():
             fam.labels(worker_id=str(wid), role=str(role)).set(1.0)
+
+
+def apply_autoscaler(reg: MetricsRegistry,
+                     s: Optional[Mapping[str, Any]]) -> None:
+    """A ``FleetAutoscaler.get_stats()`` dict: policy gauges/counters plus
+    the per-action decision breakdown."""
+    if not s:
+        return
+    _apply_table(reg, AUTOSCALER_TABLE, s, (), {})
+    by_action = s.get("decisions_by_action")
+    if isinstance(by_action, Mapping):
+        fam = reg.counter("autoscaler_decisions",
+                          CATALOG["autoscaler_decisions"][2], ("action",))
+        for action, n in by_action.items():
+            fam.labels(action=str(action)).set(float(n))
+
+
+def apply_upgrade(reg: MetricsRegistry,
+                  s: Optional[Mapping[str, Any]]) -> None:
+    """A ``RollingUpgrade.get_stats()`` dict."""
+    if s:
+        _apply_table(reg, UPGRADE_TABLE, s, (), {})
 
 
 def apply_worker(reg: MetricsRegistry, wm: Optional[Mapping[str, Any]],

@@ -211,6 +211,14 @@ class _Family:
         with self._lock:
             self._children.clear()
 
+    def items(self) -> List[Tuple[Dict[str, str], Any]]:
+        """Snapshot of ``(labels dict, child)`` pairs. Scrape-side
+        consumers — the autoscaler's SLO reader — iterate series through
+        this instead of reaching into the render path."""
+        with self._lock:
+            return [(dict(zip(self.labelnames, key)), child)
+                    for key, child in self._children.items()]
+
     # -- rendering ---------------------------------------------------------
 
     def _label_str(self, key: Tuple[str, ...],

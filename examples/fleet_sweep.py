@@ -26,6 +26,23 @@ after a failover — must produce the same stream):
   kill        N=4 under load, one worker hard-killed mid-run, supervisor
               auto-respawns it (restart hook), retries+failover carry the
               in-flight work. Acceptance: ≥ 99% of requests token-exact.
+  autoscale   the SLO loop closed (cluster/autoscaler.py): fleet starts at
+              BENCH_FLEET_MIN under easy load, offered load jumps to
+              BENCH_FLEET_BURST× one worker's capacity mid-run — the
+              autoscaler must grow the fleet to BENCH_FLEET_MAX (spawn →
+              artifact cold-start → half-open rejoin), then drain back
+              down once the burst passes. Runs TWICE with the same seed.
+              Acceptance: ≥ 99% token-exact through all the churn, fleet
+              reaches max within 10 s of the burst, shrinks back to min,
+              and the two runs' decision ledgers are identical.
+  upgrade     N=3 replicas under live load, rolling upgrade to a new
+              (token-identical) artifact: drain → swap → golden-probe →
+              half-open rejoin, one worker at a time. Then a second
+              rollout to a BAD artifact (different vocab — the probe's
+              greedy tokens diverge) which must roll back on worker one
+              and abort. Acceptance: 100% token-exact during the good
+              rollout (zero dropped tokens), rollback proven, fleet still
+              token-exact after the abort.
   tiny        llama-tiny (real jax engines, CPU-friendly): 1 prefill + 1
               decode worker disaggregated vs a plain continuous reference
               worker, same seeded random-init weights (init key 0), same
@@ -59,11 +76,14 @@ from bench import log, pct  # noqa: E402
 from distributed_inference_engine_tpu.api.coordinator import (  # noqa: E402
     Coordinator, CoordinatorConfig,
 )
+from distributed_inference_engine_tpu.cluster.autoscaler import (  # noqa: E402
+    FleetAutoscaler, RollingUpgrade,
+)
 from distributed_inference_engine_tpu.cluster.worker import (  # noqa: E402
     WorkerServer,
 )
 from distributed_inference_engine_tpu.config import (  # noqa: E402
-    HealthConfig, ModelConfig, ServerConfig,
+    AutoscalerConfig, HealthConfig, ModelConfig, ServerConfig,
 )
 from distributed_inference_engine_tpu.models.fake import _chain  # noqa: E402
 
@@ -402,6 +422,216 @@ async def leg_kill():
     return rows
 
 
+def _spawner(spawned):
+    """Spawn-hook factory shared by the autoscale/upgrade legs: bring up a
+    fresh local WorkerServer and hand back its address (the same contract
+    as the kill leg's supervisor restart hook)."""
+    async def hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+    return hook
+
+
+async def _autoscale_once(tag):
+    """One seeded autoscale run: easy load → burst → easy load → settle.
+    Returns (row, canonical ledger). Seeds are run-independent so a second
+    call replays the same offered load."""
+    cap = bench.FLEET_SLOTS / STEP_S / bench.FLEET_NEW_TOKENS  # req/s/worker
+    base_rate = 0.5 * cap
+    burst_rate = bench.FLEET_BURST * cap
+    as_cfg = AutoscalerConfig(
+        ttft_p95_target_s=0.3, itl_p95_target_s=0.0,
+        queue_depth_target=4.0,
+        min_workers=bench.FLEET_MIN, max_workers=bench.FLEET_MAX,
+        breach_ticks=2, clear_ticks=4,
+        cooldown_up_ticks=2, cooldown_down_ticks=4,
+        # the shed path is unit-tested; this leg sizes the burst so max
+        # fleet CAN absorb it, making the decision sequence replay-stable
+        shed_ticks=10_000,
+        interval_s=0.1, seed=bench.FLEET_SEED)
+    # fast health probes (as in the kill leg) so a half-open rejoin gets
+    # its trial within one tick instead of a default probe period
+    coord_cfg = CoordinatorConfig(
+        retry_seed=bench.FLEET_SEED, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=1.0,
+                            max_consecutive_failures=3))
+    coord, workers = await start_fleet(bench.FLEET_MIN, prefix=f"{tag}w",
+                                       coord_cfg=coord_cfg)
+    await coord.deploy_model(fake_cfg(), register_shards=False)
+    spawned = []
+    scaler = FleetAutoscaler(coord, "m", spawn_hook=_spawner(spawned),
+                             cfg=as_cfg, worker_prefix=f"{tag}as")
+    await scaler.start()
+
+    n1 = bench.FLEET_REQUESTS
+    n2 = 5 * bench.FLEET_REQUESTS
+    p1 = prompts_unique(n1, bench.FLEET_SEED + 201)
+    p2 = prompts_unique(n2, bench.FLEET_SEED + 202)
+    p3 = prompts_unique(n1, bench.FLEET_SEED + 203)
+
+    peak = {"fleet": bench.FLEET_MIN, "t_max": None}
+
+    async def monitor(t_burst):
+        while peak["t_max"] is None:
+            size = scaler.get_stats()["fleet_size"]
+            peak["fleet"] = max(peak["fleet"], size)
+            if size >= as_cfg.max_workers:
+                peak["t_max"] = time.perf_counter() - t_burst
+            await asyncio.sleep(0.05)
+
+    gen0 = await worker_generated(coord)
+    r1, w1, t1, i1 = await drive(coord, p1, base_rate,
+                                 bench.FLEET_NEW_TOKENS,
+                                 bench.FLEET_SEED + 201)
+    mon = asyncio.ensure_future(monitor(time.perf_counter()))
+    r2, w2, t2, i2 = await drive(coord, p2, burst_rate,
+                                 bench.FLEET_NEW_TOKENS,
+                                 bench.FLEET_SEED + 202)
+    r3, w3, t3, i3 = await drive(coord, p3, base_rate,
+                                 bench.FLEET_NEW_TOKENS,
+                                 bench.FLEET_SEED + 203)
+    # settle: no offered load — the controller must drain back to min
+    for _ in range(150):
+        if scaler.get_stats()["fleet_size"] <= as_cfg.min_workers:
+            break
+        await asyncio.sleep(0.1)
+    mon.cancel()
+    await scaler.stop()
+    gen1 = await worker_generated(coord)
+    stats = scaler.get_stats()
+
+    prompts = p1 + p2 + p3
+    results = list(r1) + list(r2) + list(r3)
+    wall = w1 + w2 + w3
+    ttfts, itls = t1 + t2 + t3, i1 + i2 + i3
+    row = row_base(f"autoscale_{tag}", bench.FLEET_MAX, wall, prompts,
+                   results, ttfts, itls, bench.FLEET_NEW_TOKENS,
+                   burst_rate, gen0, gen1)
+    ok2, toks2 = score(p2, r2, bench.FLEET_NEW_TOKENS)
+    row["burst_goodput_toks"] = round(toks2 / w2, 1)
+    row["peak_fleet"] = peak["fleet"]
+    row["final_fleet"] = stats["fleet_size"]
+    row["time_to_max_fleet_s"] = (round(peak["t_max"], 2)
+                                  if peak["t_max"] is not None else None)
+    row["scale_ups"] = stats["scale_ups"]
+    row["scale_downs"] = stats["scale_downs"]
+    row["guard_holds"] = stats["guard_holds"]
+    row["ledger"] = stats["ledger"]
+    # canonical replay form: the action/fleet-size sequence (the reason
+    # string names whichever SLO dimension crossed first — informational)
+    ledger = [(e["action"], e["fleet_from"], e["fleet_to"])
+              for e in stats["ledger"]]
+    await stop_fleet(coord, workers)
+    for w in spawned:
+        try:
+            await w.stop()
+        except Exception:
+            pass
+    return row, ledger
+
+
+async def leg_autoscale():
+    rows = []
+    ledgers = []
+    for tag in ("a", "b"):
+        row, ledger = await _autoscale_once(tag)
+        rows.append(emit(row))
+        ledgers.append(ledger)
+        log(f"  autoscale run {tag}: token-exact "
+            f"{row['token_exact_frac']:.1%} (acceptance >= 99%), fleet "
+            f"{bench.FLEET_MIN} -> {row['peak_fleet']} -> "
+            f"{row['final_fleet']}, max reached in "
+            f"{row['time_to_max_fleet_s']}s (acceptance <= 10s), "
+            f"ledger {ledger}")
+    replay_ok = ledgers[0] == ledgers[1] and len(ledgers[0]) > 0
+    log(f"  autoscale replay: same-seed ledgers "
+        f"{'IDENTICAL' if replay_ok else 'DIVERGED'} (acceptance: "
+        f"identical)")
+    rows.append(emit({"leg": "autoscale", "summary": True,
+                      "ledgers_identical": replay_ok,
+                      "ledger": ledgers[0]}))
+    dump_leg("autoscale", rows)
+    return rows
+
+
+async def leg_upgrade():
+    n = 3
+    # fast health probes so each upgraded worker's half-open trial closes
+    # promptly and the fleet is fully healthy between rollouts
+    coord_cfg = CoordinatorConfig(
+        retry_seed=bench.FLEET_SEED, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=1.0,
+                            max_consecutive_failures=3))
+    coord, workers = await start_fleet(n, coord_cfg=coord_cfg)
+    await coord.deploy_model(fake_cfg(), register_shards=False)
+    spawned = []
+    hook = _spawner(spawned)
+
+    # -- good rollout under live load: new artifact rev, same token chain
+    good_cfg = fake_cfg(artifact_rev=2)
+    upg = RollingUpgrade(coord, "m", good_cfg, swap_hook=hook,
+                         probe_prompt=[5, 3, 2], probe_new_tokens=8)
+    rate = 0.4 * bench.FLEET_RATE * n
+    prompts = prompts_unique(2 * bench.FLEET_REQUESTS,
+                             bench.FLEET_SEED + 301)
+    gen0 = await worker_generated(coord)
+    drive_task = asyncio.ensure_future(drive(
+        coord, prompts, rate, bench.FLEET_NEW_TOKENS,
+        bench.FLEET_SEED + 301))
+    await asyncio.sleep(0.2)   # streams in flight before the first drain
+    summary = await upg.run([f"w{i}" for i in range(n)])
+    results, wall, ttfts, itls = await drive_task
+    gen1 = await worker_generated(coord)
+    row = row_base("upgrade", n, wall, prompts, results, ttfts, itls,
+                   bench.FLEET_NEW_TOKENS, rate, gen0, gen1)
+    row["upgrade_completed"] = summary["completed"]
+    row["upgraded"] = summary["upgraded"]
+    dropped = row["requests"] - row["token_exact"]
+    log(f"  upgrade: rolled {summary['upgraded']}/{n} workers under load, "
+        f"{row['token_exact']}/{row['requests']} token-exact "
+        f"({dropped} dropped/diverged, acceptance 0)")
+    rows = [emit(row)]
+
+    # -- bad rollout: vocab changes the chain, the golden probe must catch
+    # it on worker one, roll back, and abort
+    bad_cfg = fake_cfg(vocab_size=991)
+    upg2 = RollingUpgrade(coord, "m", bad_cfg, swap_hook=hook,
+                          probe_prompt=[5, 3, 2], probe_new_tokens=8)
+    summary2 = await upg2.run([f"w{i}" for i in range(n)])
+    probe = prompts_unique(8, bench.FLEET_SEED + 302)
+    exact = 0
+    for i, p in enumerate(probe):
+        r = await coord.submit("m", prompt=p,
+                               max_new_tokens=bench.FLEET_NEW_TOKENS,
+                               request_id=f"pb{i}", no_cache=True)
+        if r["tokens"] == expected_tokens(p, bench.FLEET_NEW_TOKENS):
+            exact += 1
+    row2 = {"leg": "upgrade_rollback", "workers": n,
+            "requests": len(probe), "token_exact": exact,
+            "token_exact_frac": round(exact / len(probe), 4),
+            "upgrade_completed": summary2["completed"],
+            "aborted_at": summary2.get("aborted_at"),
+            "rolled_back": summary2.get("rolled_back"),
+            "probe_failures": upg2.get_stats()["probe_failures"],
+            "rollbacks": upg2.get_stats()["rollbacks"]}
+    log(f"  upgrade rollback: bad artifact caught at "
+        f"{summary2.get('aborted_at')} (completed={summary2['completed']},"
+        f" rolled_back={summary2.get('rolled_back')}), post-abort fleet "
+        f"{exact}/{len(probe)} token-exact")
+    rows.append(emit(row2))
+    await stop_fleet(coord, workers)
+    for w in spawned:
+        try:
+            await w.stop()
+        except Exception:
+            pass
+    dump_leg("upgrade", rows)
+    return rows
+
+
 async def leg_tiny():
     """Real-engine leg: llama-tiny disaggregated through the coordinator
     must match a plain single-engine worker token-for-token (both engines
@@ -454,12 +684,15 @@ async def leg_tiny():
 
 
 LEGS = {"replicated": leg_replicated, "disagg": leg_disagg,
-        "affinity": leg_affinity, "kill": leg_kill}
+        "affinity": leg_affinity, "kill": leg_kill,
+        "autoscale": leg_autoscale, "upgrade": leg_upgrade}
 
 
 async def main_async():
     want = [s for s in os.environ.get(
-        "SWEEP_LEGS", "replicated,disagg,affinity,kill,tiny").split(",") if s]
+        "SWEEP_LEGS",
+        "replicated,disagg,affinity,kill,autoscale,upgrade,tiny"
+    ).split(",") if s]
     all_rows = []
     for name in want:
         if name == "tiny":

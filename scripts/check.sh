@@ -101,6 +101,19 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2f: fast autoscale leg -------------------------------------
+# the SLO → fleet-size loop (-m autoscale): policy hysteresis/cooldown/
+# guard rails, decision-ledger determinism, rolling upgrade with golden-
+# probe rollback, fleet-level admission shed.
+echo "== autoscaling (-m 'autoscale and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'autoscale and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: autoscale leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.

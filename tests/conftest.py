@@ -70,6 +70,11 @@ def pytest_configure(config):
         "routing, prefill/decode pools through the coordinator, affinity "
         "rebind on drain/respawn/failover; fast leg: pytest -m 'fleet "
         "and not slow')")
+    config.addinivalue_line(
+        "markers", "autoscale: SLO-driven autoscaling and rolling-upgrade "
+        "tests (policy hysteresis/cooldown/guards, decision-ledger "
+        "determinism, drain→swap→probe→rejoin, fleet admission shed; "
+        "fast leg: pytest -m 'autoscale and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

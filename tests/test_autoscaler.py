@@ -1,0 +1,447 @@
+"""SLO-driven autoscaling tests (-m autoscale): the pure policy's
+hysteresis/cooldown/guard rails and seeded-ledger determinism (no jax, no
+fleet), the windowed-scrape reader's failed-scrape handling, and live
+fake-fleet integration — scale-up/down under a burst, zero-token-loss
+rolling upgrade with golden-probe rollback, and the fleet-level admission
+shed's typed ``overloaded`` error.
+
+Same determinism discipline as the fleet suite: the fake continuous
+engine's next token is a crc32 chain over the full context, so every
+response — across scale events, drains, and artifact swaps — is checkable
+token-for-token.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_inference_engine_tpu.api.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.autoscaler import (
+    ACTION_DOWN,
+    ACTION_HOLD,
+    ACTION_SHED_OFF,
+    ACTION_SHED_ON,
+    ACTION_UP,
+    AutoscalerPolicy,
+    FleetAutoscaler,
+    RollingUpgrade,
+    SLOSnapshot,
+    percentile_from_buckets,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+from distributed_inference_engine_tpu.config import (
+    AutoscalerConfig,
+    HealthConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.engine.types import (
+    EngineOverloadedError,
+)
+from distributed_inference_engine_tpu.models.fake import _chain
+
+pytestmark = pytest.mark.autoscale
+
+VOCAB = 997
+
+
+def expected_tokens(prompt, n, vocab=VOCAB):
+    st = 0
+    for t in prompt:
+        st = _chain(st, t)
+    out = []
+    for _ in range(n):
+        nxt = st % vocab
+        st = _chain(st, nxt)
+        out.append(nxt)
+    return out
+
+
+def snap(**kw):
+    """A breachable baseline: pressure comes from queue_depth unless the
+    test overrides the latency dimensions."""
+    base = dict(ttft_p95_s=0.0, itl_p95_s=0.0, queue_depth=0.0,
+                fleet_size=2, window_requests=10)
+    base.update(kw)
+    return SLOSnapshot(**base)
+
+
+def policy_cfg(**kw):
+    base = dict(ttft_p95_target_s=0.5, itl_p95_target_s=0.0,
+                queue_depth_target=4.0, min_workers=1, max_workers=4,
+                breach_ticks=2, clear_ticks=2, cooldown_up_ticks=2,
+                cooldown_down_ticks=2, shed_ticks=3, interval_s=0.1,
+                seed=0)
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+BREACH = dict(queue_depth=12.0)      # pressure 3.0 -> attainment 0.33
+CLEAR = dict(queue_depth=0.0)        # pressure 0   -> attainment 1.0
+
+
+# ------------------------------------------------------ percentile reader
+
+def test_percentile_interpolates_within_bucket():
+    # target count 5 falls exactly on the first bucket boundary
+    assert percentile_from_buckets({"0.1": 5, "0.25": 9, "+Inf": 10},
+                                   0.5) == pytest.approx(0.1)
+    # mass in +Inf reports the largest finite bound, not infinity
+    assert percentile_from_buckets({"0.1": 5, "0.25": 9, "+Inf": 10},
+                                   0.95) == pytest.approx(0.25)
+
+
+def test_percentile_empty_and_nonmonotone():
+    assert percentile_from_buckets({}, 0.95) == 0.0
+    assert percentile_from_buckets({"0.1": 0, "+Inf": 0}, 0.95) == 0.0
+    # a departed worker can make the merged window non-monotone; the
+    # reader clamps instead of returning garbage
+    v = percentile_from_buckets({"0.1": 5, "0.25": 3, "+Inf": 5}, 0.5)
+    assert 0.0 <= v <= 0.1
+
+
+# ------------------------------------------------------- policy hysteresis
+
+def test_scale_up_needs_sustained_breach():
+    p = AutoscalerPolicy(policy_cfg(breach_ticks=2))
+    d1 = p.evaluate(snap(fleet_size=1, **BREACH))
+    assert (d1.action, d1.reason) == (ACTION_HOLD, "breach_debounce")
+    d2 = p.evaluate(snap(fleet_size=1, **BREACH))
+    assert d2.action == ACTION_UP
+    assert (d2.fleet_from, d2.fleet_to) == (1, 2)
+    assert d2.reason == "queue_depth"      # names the breaching dimension
+
+
+def test_up_cooldown_spaces_consecutive_ups():
+    p = AutoscalerPolicy(policy_cfg(breach_ticks=1, cooldown_up_ticks=3))
+    acts = [p.evaluate(snap(fleet_size=1, **BREACH)).action
+            for _ in range(4)]
+    # up at tick 1, cooldown covers ticks 2-3, next up at tick 4
+    assert acts == [ACTION_UP, ACTION_HOLD, ACTION_HOLD, ACTION_UP]
+
+
+def test_half_open_capacity_blocks_further_ups():
+    p = AutoscalerPolicy(policy_cfg(breach_ticks=1))
+    d = p.evaluate(snap(fleet_size=2, half_open=1, **BREACH))
+    assert (d.action, d.reason) == (ACTION_HOLD, "guard:half_open")
+    # trial resolved -> the still-standing breach scales immediately
+    assert p.evaluate(snap(fleet_size=2, **BREACH)).action == ACTION_UP
+
+
+def test_scale_down_needs_clear_run_and_drained_queue():
+    cfg = policy_cfg(clear_ticks=2, scale_down_queue_frac=0.25)
+    p = AutoscalerPolicy(cfg)
+    # attainment is perfect but the queue holds 2 > 0.25*4 — not "clear"
+    for _ in range(5):
+        d = p.evaluate(snap(fleet_size=2, queue_depth=2.0))
+        assert d.action == ACTION_HOLD
+    d1 = p.evaluate(snap(fleet_size=2, **CLEAR))
+    assert d1.action == ACTION_HOLD
+    d2 = p.evaluate(snap(fleet_size=2, **CLEAR))
+    assert d2.action == ACTION_DOWN
+    assert (d2.fleet_from, d2.fleet_to) == (2, 1)
+
+
+def test_min_max_clamps():
+    p = AutoscalerPolicy(policy_cfg(min_workers=1, max_workers=2,
+                                    breach_ticks=1, clear_ticks=1,
+                                    shed_ticks=10_000))
+    # at min: sustained all-clear never drops below min_workers
+    for _ in range(6):
+        assert p.evaluate(snap(fleet_size=1, **CLEAR)).action == ACTION_HOLD
+    # at max: sustained breach never grows past max_workers
+    for _ in range(6):
+        d = p.evaluate(snap(fleet_size=2, **BREACH))
+        assert (d.action, d.reason) == (ACTION_HOLD, "at_max_fleet")
+
+
+def test_shed_engages_at_max_and_releases_on_recovery():
+    p = AutoscalerPolicy(policy_cfg(max_workers=2, breach_ticks=1,
+                                    shed_ticks=3))
+    acts = [p.evaluate(snap(fleet_size=2, **BREACH)).action
+            for _ in range(4)]
+    assert acts == [ACTION_HOLD, ACTION_HOLD, ACTION_SHED_ON, ACTION_HOLD]
+    assert p.shedding
+    # the first non-breach tick lifts the shed before any other action
+    d = p.evaluate(snap(fleet_size=2, **CLEAR))
+    assert (d.action, d.reason) == (ACTION_SHED_OFF, "recovered")
+    assert not p.shedding
+
+
+def test_guards_hold_without_touching_debounce():
+    p = AutoscalerPolicy(policy_cfg(breach_ticks=2))
+    assert p.evaluate(snap(fleet_size=1, **BREACH)).action == ACTION_HOLD
+    # repair in flight / open breaker / failed scrape each hold — and none
+    # of them resets the breach run already accumulated
+    for kw, reason in ((dict(respawning=1), "guard:respawning"),
+                       (dict(breaker_open=1), "guard:breaker_open"),
+                       (dict(scrape_ok=False), "guard:no_data")):
+        d = p.evaluate(snap(fleet_size=1, **BREACH, **kw))
+        assert (d.action, d.reason) == (ACTION_HOLD, reason)
+    assert p.guard_holds == 3
+    # breach tick #2: the debounce resumes where it left off
+    assert p.evaluate(snap(fleet_size=1, **BREACH)).action == ACTION_UP
+
+
+# -------------------------------------------------------- determinism
+
+def _mixed_stream():
+    out = []
+    for fleet, kw in [(1, BREACH), (1, BREACH), (2, dict(respawning=1)),
+                      (2, BREACH), (2, BREACH), (2, BREACH), (2, CLEAR),
+                      (3, CLEAR), (3, CLEAR), (3, CLEAR), (3, CLEAR),
+                      (2, dict(scrape_ok=False)), (2, CLEAR), (2, CLEAR),
+                      (2, CLEAR), (2, CLEAR)]:
+        out.append(snap(fleet_size=fleet, **kw))
+    return out
+
+
+def test_same_seed_identical_ledger_and_victims():
+    a = AutoscalerPolicy(policy_cfg(seed=42))
+    b = AutoscalerPolicy(policy_cfg(seed=42))
+    for s in _mixed_stream():
+        a.evaluate(s)
+        b.evaluate(s)
+    assert a.ledger == b.ledger
+    assert a.ledger                     # the stream produced real actions
+    cands = ["w3", "w0", "w2", "w1", "w4"]
+    assert ([a.pick_victim(cands) for _ in range(8)]
+            == [b.pick_victim(cands) for _ in range(8)])
+
+
+def test_pick_victim_is_order_insensitive_and_total():
+    # same seed + same candidate SET -> same pick, whatever the input order
+    a = AutoscalerPolicy(policy_cfg(seed=3))
+    b = AutoscalerPolicy(policy_cfg(seed=3))
+    assert (a.pick_victim(["b", "a", "c"])
+            == b.pick_victim(["c", "b", "a"]))
+    assert a.pick_victim(["only"]) == "only"
+    with pytest.raises(ValueError):
+        a.pick_victim([])
+
+
+# ------------------------------------------------- windowed scrape reader
+
+def test_failed_scrape_does_not_consume_the_window():
+    coord = Coordinator(CoordinatorConfig())
+    scaler = FleetAutoscaler(coord, "m", cfg=AutoscalerConfig(),
+                             managed=["w0"])
+    fam = coord.obs_registry.get("engine_ttft_seconds")
+    if fam is None:
+        fam = coord.obs_registry.histogram(
+            "engine_ttft_seconds", labelnames=("worker_id",))
+    labels = {ln: ("w0" if ln == "worker_id" else "m")
+              for ln in fam.labelnames}
+    child = fam.labels(**labels)
+
+    child.set_snapshot({"0.1": 5.0, "+Inf": 8.0}, 1.0, 8.0)
+    window, n = scaler._merged_window("engine_ttft_seconds", {"w0"}, True)
+    assert n == 8.0 and window["0.1"] == 5.0
+
+    # cumulative counts advance, but this tick's scrape failed: the reader
+    # must report nothing AND keep the previous good baseline
+    child.set_snapshot({"0.1": 6.0, "+Inf": 12.0}, 2.0, 12.0)
+    window, n = scaler._merged_window("engine_ttft_seconds", {"w0"}, False)
+    assert (window, n) == ({}, 0.0)
+
+    # telemetry returns: the window is the delta since the last GOOD tick,
+    # not the all-time cumulative counts
+    window, n = scaler._merged_window("engine_ttft_seconds", {"w0"}, True)
+    assert n == 4.0 and window["0.1"] == 1.0
+
+
+# ------------------------------------------------------ live fleet helpers
+
+STEP_S = 0.005
+NEW_TOKENS = 8
+
+
+def fake_cfg(**meta):
+    md = {"continuous": 1, "max_slots": 4, "step_latency_s": STEP_S}
+    md.update(meta)
+    return ModelConfig(name="m", architecture="fake", metadata=md)
+
+
+def fast_health_cfg():
+    """Fast probes so a half-open rejoin gets its trial within a tick."""
+    return CoordinatorConfig(
+        retry_seed=7, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=1.0,
+                            max_consecutive_failures=3))
+
+
+async def start_fleet(n_workers, coord_cfg=None, model_meta=None):
+    coord = Coordinator(coord_cfg or fast_health_cfg())
+    await coord.start()
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    await coord.deploy_model(fake_cfg(**(model_meta or {})),
+                             register_shards=False)
+    return coord, workers
+
+
+async def stop_all(coord, workers, spawned=()):
+    await coord.stop()
+    for w in list(workers.values()) + list(spawned):
+        try:
+            await w.stop()
+        except Exception:
+            pass
+
+
+def spawner(spawned):
+    async def hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+    return hook
+
+
+async def drive(coord, prompts, rate, n_tok=NEW_TOKENS):
+    tasks = []
+    for p in prompts:
+        tasks.append(asyncio.ensure_future(
+            coord.submit("m", prompt=p, max_new_tokens=n_tok,
+                         no_cache=True)))
+        await asyncio.sleep(1.0 / rate)
+    return await asyncio.gather(*tasks)
+
+
+def assert_exact(prompts, results, n_tok=NEW_TOKENS, vocab=VOCAB):
+    for p, r in zip(prompts, results):
+        assert list(r["tokens"]) == expected_tokens(p, n_tok, vocab)
+
+
+# --------------------------------------------------- fleet admission shed
+
+async def test_admission_shed_is_typed_and_reversible():
+    coord, workers = await start_fleet(1)
+    try:
+        coord.set_admission_shed(True, reason="fleet_overloaded",
+                                 retry_after_s=2.5)
+        with pytest.raises(EngineOverloadedError) as ei:
+            await coord.submit("m", prompt=[1, 2, 3], max_new_tokens=4,
+                               no_cache=True)
+        assert ei.value.reason == "fleet_overloaded"
+        assert ei.value.retry_after_s == 2.5
+        with pytest.raises(EngineOverloadedError):
+            await coord.submit_stream("m", prompt=[4, 5, 6],
+                                      max_new_tokens=4)
+        # recovery: the same request is served, token-exact
+        coord.set_admission_shed(False)
+        r = await coord.submit("m", prompt=[1, 2, 3], max_new_tokens=4,
+                               no_cache=True)
+        assert list(r["tokens"]) == expected_tokens([1, 2, 3], 4)
+        stats = coord.get_stats()
+        assert stats["admission_sheds"] == 2
+        assert stats["admission_shed_active"] == 0
+    finally:
+        await stop_all(coord, workers)
+
+
+# ------------------------------------------------ autoscaler over a fleet
+
+async def test_autoscaler_scales_up_then_back_down_live():
+    coord, workers = await start_fleet(1)
+    spawned = []
+    as_cfg = AutoscalerConfig(
+        ttft_p95_target_s=0.25, itl_p95_target_s=0.0,
+        queue_depth_target=3.0, min_workers=1, max_workers=2,
+        breach_ticks=2, clear_ticks=3, cooldown_up_ticks=2,
+        cooldown_down_ticks=3, shed_ticks=10_000, interval_s=0.1, seed=7)
+    scaler = FleetAutoscaler(coord, "m", spawn_hook=spawner(spawned),
+                             cfg=as_cfg)
+    await scaler.start()
+    try:
+        # one worker absorbs ~100 req/s (4 slots / 5ms step / 8 tokens);
+        # 2.5x that backlogs the queue and breaches within a few ticks
+        prompts = [[800 + i, i % 7, 3] for i in range(120)]
+        results = await drive(coord, prompts, rate=250.0)
+        assert_exact(prompts, results)
+
+        # the burst forced a scale-up...
+        stats = scaler.get_stats()
+        assert stats["scale_ups"] >= 1
+        assert stats["ledger"][0]["action"] == "up"
+        # ...and the idle settle drains the fleet back to min without
+        # dropping anything (all 120 streams already verified exact)
+        for _ in range(150):
+            if scaler.get_stats()["fleet_size"] <= as_cfg.min_workers:
+                break
+            await asyncio.sleep(0.1)
+        stats = scaler.get_stats()
+        assert stats["fleet_size"] == as_cfg.min_workers
+        assert stats["scale_downs"] >= 1
+
+        text = await coord.metrics_text(refresh_workers=False)
+        assert "autoscaler_fleet_size" in text
+        assert "autoscaler_decisions" in text
+    finally:
+        await scaler.stop()
+        await stop_all(coord, workers, spawned)
+
+
+# -------------------------------------------------------- rolling upgrade
+
+async def test_rolling_upgrade_token_exact_then_rollback_on_bad_artifact():
+    coord, workers = await start_fleet(2)
+    spawned = []
+    hook = spawner(spawned)
+    try:
+        # -- good rollout under live load: zero token loss ----------------
+        upg = RollingUpgrade(coord, "m", fake_cfg(artifact_rev=2),
+                             swap_hook=hook, probe_prompt=[5, 3, 2],
+                             probe_new_tokens=8)
+        prompts = [[600 + i, i % 5, 9] for i in range(40)]
+        load = asyncio.ensure_future(drive(coord, prompts, rate=60.0))
+        await asyncio.sleep(0.05)
+        summary = await upg.run(["w0", "w1"])
+        results = await load
+        assert summary["completed"] is True
+        assert summary["upgraded"] == 2
+        assert_exact(prompts, results)
+
+        # both upgraded workers must finish their half-open trials before
+        # the next rollout captures its golden reference
+        for _ in range(100):
+            if len(coord.lb.healthy_workers()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(coord.lb.healthy_workers()) == 2
+
+        # -- bad artifact: vocab 991 diverges from the greedy reference ---
+        upg2 = RollingUpgrade(coord, "m", fake_cfg(vocab_size=991),
+                              swap_hook=hook, probe_prompt=[5, 3, 2],
+                              probe_new_tokens=8)
+        summary2 = await upg2.run(["w0", "w1"])
+        assert summary2["completed"] is False
+        assert summary2["aborted_at"] == "w0"
+        assert summary2["rolled_back"] is True
+        assert upg2.get_stats() == {"upgraded": 0, "probe_failures": 1,
+                                    "rollbacks": 1, "in_progress": 0}
+        # the stored config still points at the good artifact
+        assert coord._model_configs["m"].metadata.get("vocab_size") is None
+
+        # post-abort the fleet serves the GOOD artifact's tokens
+        for _ in range(100):
+            if len(coord.lb.healthy_workers()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        post = [[70 + i, 2] for i in range(8)]
+        results = await drive(coord, post, rate=50.0)
+        assert_exact(post, results)
+
+        text = await coord.metrics_text(refresh_workers=False)
+        assert "upgrade_rollbacks" in text
+    finally:
+        await stop_all(coord, workers, spawned)

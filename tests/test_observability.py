@@ -391,6 +391,25 @@ async def test_coordinator_metrics_text_covers_fleet():
         await stop_fleet(coord, workers)
 
 
+async def test_unregistered_worker_series_drop_from_scrape():
+    """A removed worker's labelled series must vanish at the next scrape:
+    the coordinator prunes its cached per-worker metrics against the live
+    membership instead of re-applying ghost samples forever."""
+    coord, workers = await make_fleet(n_workers=2)
+    try:
+        await coord.submit("echo", prompt=[1, 2], max_new_tokens=2)
+        text = await coord.metrics_text()
+        assert 'worker_id="w1"' in text
+        coord.remove_worker("w1")
+        # refresh_workers=False: nothing repolls, so any w1 line in this
+        # render could only come from the stale cache
+        text = await coord.metrics_text(refresh_workers=False)
+        assert 'worker_id="w1"' not in text
+        assert 'worker_id="w0"' in text
+    finally:
+        await stop_fleet(coord, workers)
+
+
 async def test_worker_metrics_rpc_and_http():
     w = WorkerServer(ServerConfig(worker_id="wm", port=0))
     host, port = await w.start()
