@@ -96,6 +96,17 @@ class CoordinatorConfig:
     retry_jitter_frac: float = 0.25
     retry_seed: Optional[int] = None      # None ⇒ nondeterministic jitter
     drain_timeout_s: float = 30.0         # default budget for drain_worker
+    # KV fabric (engine/kv_fabric.py): coordinator-mediated KV page
+    # migration under prefix_affinity — drain hands hot prefixes (and
+    # their bindings) to a survivor, respawn/scale-up pre-warms the new
+    # worker BEFORE half-open, and stream failover imports the dead
+    # stream's pages into the alternate instead of re-prefilling.
+    kv_fabric: bool = True
+    prewarm_top_k: int = 8                # bindings migrated per pre-warm
+    fabric_timeout_s: float = 10.0        # per kv_export/kv_import RPC
+    fabric_cache_capacity: int = 128      # wires held for failover resume
+    fabric_snapshot_delay_s: float = 0.05  # let admission land before the
+                                           # opportunistic background pull
     # supervisor loop (start_supervisor): auto-respawn workers the health
     # machinery declares dead, via a pluggable restart hook. Backoff
     # between failed attempts is seeded by retry_seed (same jitter source
@@ -204,6 +215,18 @@ class Coordinator:
         self._retry_rand = random.Random(self.config.retry_seed)
         self._model_configs: Dict[str, ModelConfig] = {}
         self._tokenizers: Dict[Tuple[str, str], Any] = {}  # (model, path) -> tokenizer
+        # -- KV fabric state: the prompt head behind each affinity key (so
+        # the coordinator can ask a worker to export without re-learning
+        # the prompt), and a bounded LRU of exported wires — the failover
+        # import source when the bound worker is already dead
+        self._affinity_prompts: "OrderedDict[str, Tuple[int, ...]]" = (
+            OrderedDict())
+        self._affinity_prompts_cap = 4096
+        self._fabric_cache: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._fabric_prewarm_pushes = 0
+        self._fabric_prewarm_failures = 0
+        self._fabric_failover_imports = 0
+        self._fabric_snapshot_tasks: set = set()
         # disaggregated deployments: model -> (prefill worker ids, rr cursor)
         self._disagg: Dict[str, "_DisaggPool"] = {}
         # -- observability: unified metrics + recent request traces --------
@@ -235,6 +258,12 @@ class Coordinator:
             return
         self._running = False
         await self.stop_supervisor()
+        if self._fabric_snapshot_tasks:
+            for t in list(self._fabric_snapshot_tasks):
+                t.cancel()
+            await asyncio.gather(*self._fabric_snapshot_tasks,
+                                 return_exceptions=True)
+            self._fabric_snapshot_tasks.clear()
         await self.batcher.stop()
         await self.router.stop()
         await self.lb.stop()
@@ -270,11 +299,18 @@ class Coordinator:
         worker was holding."""
         if timeout_s is None:
             timeout_s = self.config.drain_timeout_s
+        # KV fabric: hand the retiree's hot prefixes off BEFORE quarantine
+        # (quarantine invalidates its bindings — after that the affinity
+        # table no longer remembers what this worker was serving)
+        handed_off = await self._fabric_drain_handoff(worker_id)
         self.lb.quarantine(worker_id)
         client = (self.router.client_for(worker_id)
                   if worker_id in self.router.workers
                   else self.lb.client_for(worker_id))
         summary = await client.drain(timeout_s=timeout_s)
+        if handed_off:
+            summary = dict(summary or {})
+            summary["kv_fabric_handoff"] = handed_off
         self._drains += 1
         if remove:
             self.remove_worker(worker_id)
@@ -425,15 +461,24 @@ class Coordinator:
         for name, mcfg in self._model_configs.items():
             shards = [s for s in self.registry.all_shards(name, mcfg.version)
                       if s.worker_id == worker_id]
-            if not shards:
+            if not shards and self.registry.all_shards(name, mcfg.version):
+                # sharded model, none of its shards on this worker
                 continue
             # a successful load RPC is the proof of life — a hook that
-            # spawned a zombie fails here and counts as a failed attempt
+            # spawned a zombie fails here and counts as a failed attempt.
+            # LB-placed (register_shards=False) models have no shard rows
+            # at all but still need reloading, or the replacement rejoins
+            # unable to serve (and the fabric pre-warm has no engine to
+            # import into).
             await self.router.client_for(worker_id).load_model(
                 mcfg, timeout=self.config.supervisor_load_timeout_s)
             for s in shards:
                 s.status = ModelStatus.READY
         self.router.mark_worker_success(worker_id)
+        # pre-warm BEFORE half-open: the trial probe should land against
+        # imported KV, not a cold prefix cache
+        if self._fabric_on():
+            await self.prewarm_worker(worker_id)
         # rejoin CAUTIOUSLY: half-open means the next pick is the one
         # trial probe — success closes the circuit, failure re-opens it
         self.lb.enter_half_open(worker_id)
@@ -611,7 +656,187 @@ class Coordinator:
             return None
         from ..engine.paged_kv import page_chain_hashes
 
-        return page_chain_hashes(list(prompt), n_pages, page)[-1].hex()
+        head = [int(t) for t in prompt[:n_pages * page]]
+        key = page_chain_hashes(head, n_pages, page)[-1].hex()
+        if self.config.kv_fabric:
+            # remember the tokens behind the key: kv_export is asked by
+            # prompt head, not by hash — the fabric needs both directions
+            self._affinity_prompts[key] = tuple(head)
+            self._affinity_prompts.move_to_end(key)
+            while len(self._affinity_prompts) > self._affinity_prompts_cap:
+                self._affinity_prompts.popitem(last=False)
+        return key
+
+    # -- KV fabric: coordinator-mediated page migration ---------------------
+    #
+    # Workers never talk to each other; the coordinator is the fabric.
+    # It snapshots hot prefixes off their bound workers (kv_export), keeps
+    # a bounded wire cache, and re-lands the pages (kv_import) on three
+    # triggers: graceful drain (handoff to a survivor), respawn/scale-up
+    # (pre-warm BEFORE half-open), and stream failover (resume warm
+    # instead of re-prefilling). Every path is best-effort — a failed or
+    # rejected import degrades to the pre-fabric behaviour, a cold prefill.
+
+    def _fabric_on(self) -> bool:
+        return (self.config.kv_fabric
+                and self.lb.strategy is LoadBalancerStrategy.PREFIX_AFFINITY)
+
+    def _fabric_client(self, worker_id: str):
+        return (self.router.client_for(worker_id)
+                if worker_id in self.router.workers
+                else self.lb.client_for(worker_id))
+
+    def _fabric_default_model(self) -> Optional[str]:
+        return next(iter(self._model_configs), None)
+
+    def _fabric_cache_put(self, key: str, wire: Dict[str, Any]) -> None:
+        self._fabric_cache[key] = wire
+        self._fabric_cache.move_to_end(key)
+        while len(self._fabric_cache) > self.config.fabric_cache_capacity:
+            self._fabric_cache.popitem(last=False)
+
+    async def fabric_pull(self, model: str, key: str,
+                          source_worker_id: str) -> Optional[Dict[str, Any]]:
+        """Export ``key``'s prefix pages off ``source_worker_id`` into the
+        coordinator's wire cache. Returns the wire, or None when the
+        prompt behind the key is unknown, the export comes back empty
+        (worker never prefilled it), or the RPC fails — all non-fatal."""
+        tokens = self._affinity_prompts.get(key)
+        if tokens is None:
+            return None
+        try:
+            wire = await self._fabric_client(source_worker_id).kv_export(
+                model, list(tokens), timeout=self.config.fabric_timeout_s)
+        except TRANSPORT_ERRORS + (WorkerRPCError,):  # graftlint: ok[swallowed-transport-error] best-effort snapshot; the fallback is a normal prefill
+            return None
+        if wire:
+            self._fabric_cache_put(key, wire)
+        return wire or None
+
+    async def prewarm_worker(self, worker_id: str,
+                             model: Optional[str] = None,
+                             top_k: Optional[int] = None) -> int:
+        """Push the fleet's hottest bound prefixes into ``worker_id``'s
+        host KV tier. Called before ``enter_half_open`` on respawn and
+        scale-up so the trial probe admits against imported pages. Wires
+        come from the snapshot cache, else a live export from the bound
+        worker. Never raises; returns the number of prefixes landed."""
+        if not self._fabric_on():
+            return 0
+        if model is None:
+            model = self._fabric_default_model()
+        if model is None:
+            return 0
+        k = self.config.prewarm_top_k if top_k is None else top_k
+        pushed = 0
+        for key, bound in self.lb.top_bindings(k):
+            if bound == worker_id:
+                continue
+            wire = self._fabric_cache.get(key)
+            if wire is None:
+                wire = await self.fabric_pull(model, key, bound)
+            if wire is None:
+                self._fabric_prewarm_failures += 1
+                continue
+            if await self._fabric_push(model, key, worker_id, wire):
+                pushed += 1
+        return pushed
+
+    async def _fabric_push(self, model: str, key: str, worker_id: str,
+                           wire: Dict[str, Any]) -> bool:
+        """One kv_import, fully accounted: a transport failure or a typed
+        checksum reject counts as a pre-warm failure (the target simply
+        stays cold), success as a push."""
+        try:
+            res = await self._fabric_client(worker_id).kv_import(
+                model, wire, timeout=self.config.fabric_timeout_s)
+        except TRANSPORT_ERRORS + (WorkerRPCError,):  # graftlint: ok[swallowed-transport-error] pre-warm is advisory; the target serves cold
+            self._fabric_prewarm_failures += 1
+            return False
+        if res.get("rejected"):
+            # the worker refused the wire (checksum/shape mismatch) —
+            # never install suspect KV, fall back to prefill
+            self._fabric_prewarm_failures += 1
+            return False
+        self._fabric_prewarm_pushes += 1
+        return True
+
+    async def _fabric_failover_import(self, model: str, key: str,
+                                      worker_id: str) -> bool:
+        """Failover resume: land the dead stream's cached wire on the
+        alternate so the prefix replay admits warm. Cache-only — the
+        bound worker just died, there is nobody left to export from."""
+        wire = self._fabric_cache.get(key)
+        if wire is None:
+            return False
+        if not await self._fabric_push(model, key, worker_id, wire):
+            return False
+        self._fabric_failover_imports += 1
+        return True
+
+    def _spawn_fabric_snapshot(self, model: str, key: str,
+                               worker_id: str) -> None:
+        """Background snapshot of a freshly-routed prefix off its bound
+        worker — the failover import source. Delayed slightly, then retried
+        a few times: the snapshot races the prefill that creates the pages,
+        and an export taken too early is simply empty."""
+
+        async def _snap():
+            try:
+                delay = self.config.fabric_snapshot_delay_s
+                for attempt in range(4):
+                    gap = delay if attempt == 0 else max(delay, 0.02)
+                    if gap > 0:
+                        await asyncio.sleep(gap)
+                    if await self.fabric_pull(model, key, worker_id):
+                        return
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # graftlint: ok[swallowed-transport-error] fire-and-forget snapshot; a miss only means a colder failover
+                pass
+
+        task = asyncio.get_running_loop().create_task(_snap())
+        self._fabric_snapshot_tasks.add(task)
+        task.add_done_callback(self._fabric_snapshot_tasks.discard)
+
+    async def _fabric_drain_handoff(self,
+                                    worker_id: str) -> Optional[Dict[str, Any]]:
+        """Migrate the retiree's bound prefixes to the least-loaded
+        survivor: export while the retiree is still alive, import into the
+        target, then REBIND (not drop) the affinity entries so the next
+        request for each prefix routes straight to the warm copy."""
+        if not self._fabric_on():
+            return None
+        keys = self.lb.bindings_for(worker_id)[:self.config.prewarm_top_k]
+        if not keys:
+            return None
+        survivors = [s for s in self.lb.healthy_workers()
+                     if s.worker_id != worker_id]
+        if not survivors:
+            return None
+        target = min(survivors,
+                     key=lambda s: s.active_connections).worker_id
+        model = self._fabric_default_model()
+        warmed = 0
+        if model is not None:
+            for key in keys:
+                wire = self._fabric_cache.get(key)
+                if wire is None:
+                    wire = await self.fabric_pull(model, key, worker_id)
+                if wire is None:
+                    continue
+                if await self._fabric_push(model, key, target, wire):
+                    warmed += 1
+        # hand off ALL bindings, warm or not: the target is the new owner
+        # either way and routing there keeps the table stable
+        moved = self.lb.rebind_affinity(worker_id, target)
+        if not moved and not warmed:
+            return None
+        logger.info("kv fabric: drained %s — %d binding(s) handed to %s, "
+                    "%d prefix(es) imported warm", worker_id, moved,
+                    target, warmed)
+        return {"target": target, "bindings_moved": moved,
+                "prefixes_warmed": warmed}
 
     # -- request path -------------------------------------------------------
 
@@ -824,6 +1049,12 @@ class Coordinator:
         else:
             worker_id = self.lb.get_worker(affinity=affinity).worker_id
         trace.mark("routed")
+        if (affinity is not None and self._fabric_on()
+                and affinity not in self._fabric_cache):
+            # opportunistic snapshot: pull this prefix's pages off the bound
+            # worker in the background so a later failover can import them
+            # even though the binding's owner is dead by then
+            self._spawn_fabric_snapshot(model, affinity, worker_id)
 
         req = request_from_dict({
             "prompt": list(prompt), "max_new_tokens": max_new_tokens,
@@ -887,6 +1118,14 @@ class Coordinator:
                 # binding still pointing at the dead worker is known-stale
                 # even though its breaker may not have tripped yet
                 self.lb.invalidate_affinity(worker_id)
+                if affinity is not None and self._fabric_on():
+                    # resume WARM: import the dead stream's KV pages from
+                    # the snapshot cache so the prefix replay admits against
+                    # imported pages instead of re-prefilling cold — and
+                    # hand the binding to the importer
+                    if await self._fabric_failover_import(model, affinity,
+                                                          alt):
+                        self.lb.bind_affinity(affinity, alt)
                 attempt += 1
                 self._dispatch_retries += 1
                 if delivered:
@@ -1071,6 +1310,12 @@ class Coordinator:
                     results[idx] = e
                     continue
                 self._trace_mark(inp, "routed")
+                aff = inp.get("affinity")
+                if (aff is not None and self._fabric_on()
+                        and aff not in self._fabric_cache):
+                    # snapshot the freshly-bound prefix off its worker so a
+                    # later failover/pre-warm can land it somewhere else
+                    self._spawn_fabric_snapshot(model, aff, picked.worker_id)
                 groups.setdefault(picked.worker_id, []).append(idx)
         else:
             picked = self.lb.get_worker()
@@ -1233,6 +1478,16 @@ class Coordinator:
                 tried.add(alt)
                 # moving the batch off wid: its affinity bindings are stale
                 self.lb.invalidate_affinity(wid)
+                if self._fabric_on():
+                    # resume warm on the alternate: land each dead prefix's
+                    # cached wire there and hand the binding over, so the
+                    # retry (and everything after it) admits against
+                    # imported KV instead of re-prefilling cold
+                    for akey in dict.fromkeys(keys):
+                        if (akey in self._affinity_prompts
+                                and await self._fabric_failover_import(
+                                    model, akey, alt)):
+                            self.lb.bind_affinity(akey, alt)
             attempt += 1
             self._dispatch_retries += 1
             delay = self._retry_backoff_s(attempt - 1)
@@ -1628,6 +1883,10 @@ class Coordinator:
             "admission_shed_active": 1 if self._admission_shed else 0,
             "supervisor_respawns": self._supervisor_respawns,
             "supervisor_crashloop_opens": self._supervisor_crashloop_opens,
+            "kv_fabric_prewarm_pushes": self._fabric_prewarm_pushes,
+            "kv_fabric_prewarm_failures": self._fabric_prewarm_failures,
+            "kv_fabric_failover_imports": self._fabric_failover_imports,
+            "kv_fabric_cached_wires": len(self._fabric_cache),
             "supervisor": {
                 "armed": self._restart_hook is not None,
                 "degraded_workers": sorted(self._degraded),

@@ -457,6 +457,9 @@ class FleetAutoscaler:
                                       register_shards=False,
                                       load_timeout_s=self._load_timeout_s)
         self._managed.append(wid)
+        # KV fabric pre-warm BEFORE half-open: the trial probe should hit
+        # imported prefix pages, not pay a cold prefill (best-effort)
+        await self.coord.prewarm_worker(wid, model=self.model)
         # cautious rejoin: first pick is the trial probe
         self.coord.lb.enter_half_open(wid)
         self._scale_ups += 1

@@ -125,6 +125,7 @@ class LoadBalancer:
         self._affinity_hits = 0
         self._affinity_misses = 0
         self._affinity_rebinds = 0
+        self._affinity_handoffs = 0   # bindings MOVED (KV fabric), not dropped
         self._strategies = {
             LoadBalancerStrategy.ROUND_ROBIN: self._round_robin,
             LoadBalancerStrategy.LEAST_CONNECTIONS: self._least_connections,
@@ -325,6 +326,45 @@ class LoadBalancer:
         self._affinity_rebinds += len(stale)
         return len(stale)
 
+    def bindings_for(self, worker_id: str) -> List[Hashable]:
+        """One worker's bound prefix keys, most-recently-used first — the
+        drain handoff's export list."""
+        return [k for k in reversed(self._affinity)
+                if self._affinity[k] == worker_id]
+
+    def top_bindings(self, k: int = 0) -> List[Tuple[Hashable, str]]:
+        """The hottest (MRU-first) affinity bindings fleet-wide as
+        ``(key, worker_id)`` pairs; all of them when ``k <= 0``. The
+        coordinator's pre-warm source set."""
+        out = [(key, self._affinity[key]) for key in reversed(self._affinity)]
+        return out[:k] if k > 0 else out
+
+    def bind_affinity(self, key: Hashable, worker_id: str) -> bool:
+        """Explicitly (re)bind one key — the stream-failover handoff after
+        the alternate imported the prefix KV. False when the worker is not
+        registered. Counts as a handoff, not a rebind: the KV moved with
+        the binding."""
+        if worker_id not in self.workers:
+            return False
+        self._bind_affinity(key, worker_id)
+        self._affinity_handoffs += 1
+        return True
+
+    def rebind_affinity(self, from_worker: str, to_worker: str) -> int:
+        """HAND OFF every binding from one worker to another (the drain
+        path, after the target imported the prefixes' KV) instead of
+        dropping them cold. Recency is preserved — the moved bindings keep
+        their LRU positions. No-op when the target is unregistered."""
+        if to_worker not in self.workers:
+            return 0
+        moved = 0
+        for key, bound in self._affinity.items():
+            if bound == from_worker:
+                self._affinity[key] = to_worker
+                moved += 1
+        self._affinity_handoffs += moved
+        return moved
+
     def _round_robin(self, healthy: List[WorkerStats]) -> WorkerStats:
         return healthy[next(self._rr) % len(healthy)]
 
@@ -448,5 +488,6 @@ class LoadBalancer:
             "affinity_hits": self._affinity_hits,
             "affinity_misses": self._affinity_misses,
             "affinity_rebinds": self._affinity_rebinds,
+            "affinity_handoffs": self._affinity_handoffs,
             "affinity_bindings": len(self._affinity),
         }

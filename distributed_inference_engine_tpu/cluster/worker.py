@@ -227,6 +227,13 @@ class WorkerServer(FramedServerMixin):
         self._last_load_s: Dict[str, float] = {}
         self._artifact_hits = 0
         self._artifact_misses = 0
+        # KV fabric (engine/kv_fabric.py): pages migrated in/out of this
+        # worker's host tier over the kv_export/kv_import verbs
+        self._kv_fabric_exports = 0
+        self._kv_fabric_imports = 0
+        self._kv_fabric_export_bytes = 0
+        self._kv_fabric_import_bytes = 0
+        self._kv_fabric_import_fallbacks = 0
         self._methods: Dict[str, Callable[[Dict[str, Any]], Awaitable[Any]]] = {
             "ping": self._rpc_ping,
             "generate": self._rpc_generate,
@@ -234,6 +241,8 @@ class WorkerServer(FramedServerMixin):
             "generate_prefilled": self._rpc_generate_prefilled,
             "prefill_generate": self._rpc_prefill_generate,
             "prefix_probe": self._rpc_prefix_probe,
+            "kv_export": self._rpc_kv_export,
+            "kv_import": self._rpc_kv_import,
             "load_model": self._rpc_load_model,
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
@@ -670,6 +679,54 @@ class WorkerServer(FramedServerMixin):
             out.append(kv.probe_prefix(hashes) * kv.page_size)
         return {"model": name, "cached_tokens": out, "page_size": my_page}
 
+    # -- KV fabric (engine/kv_fabric.py) ------------------------------------
+
+    async def _rpc_kv_export(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Fabric op: serialize the longest locally-resident full-page
+        prefix of ``tokens`` as a checksummed wire dict (None when cold).
+        Deliberately NOT gated by ``_admit()``: a DRAINING worker must
+        keep exporting — the drain handoff pulls its hot prefixes out
+        while in-flight work finishes."""
+        from ..engine.kv_fabric import wire_nbytes
+
+        name, engine = self._engine_for(msg, "kv_export")
+        tokens = [int(t) for t in msg.get("tokens", [])]
+        if not tokens:
+            raise ValueError("missing 'tokens'")
+        max_pages = int(msg.get("max_pages", 0))
+        loop = asyncio.get_running_loop()
+        wire = await loop.run_in_executor(
+            self._executor, engine.kv_export, tokens, max_pages)
+        if wire is not None:
+            self._kv_fabric_exports += 1
+            self._kv_fabric_export_bytes += wire_nbytes(wire)
+        return {"model": name, "wire": wire}
+
+    async def _rpc_kv_import(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Fabric op: validate + land an exported prefix in the local host
+        tier and start its layer-wise restage. A rejected wire (checksum /
+        geometry mismatch) stores NOTHING and reports ``rejected`` in the
+        payload — the caller counts a fallback and the next admission pays
+        normal prefill; wrong KV is never served. Not ``_admit()``-gated:
+        pre-warm runs before the worker takes traffic (half-open)."""
+        from ..engine.kv_fabric import FabricRejected, wire_nbytes
+
+        name, engine = self._engine_for(msg, "kv_import")
+        wire = msg.get("wire")
+        if not wire:
+            raise ValueError("missing 'wire'")
+        loop = asyncio.get_running_loop()
+        try:
+            imported = await loop.run_in_executor(
+                self._executor, engine.kv_import, wire)
+        except FabricRejected as exc:
+            self._kv_fabric_import_fallbacks += 1
+            return {"model": name, "imported_pages": 0,
+                    "rejected": str(exc)}
+        self._kv_fabric_imports += 1
+        self._kv_fabric_import_bytes += wire_nbytes(wire)
+        return {"model": name, "imported_pages": int(imported)}
+
     async def _rpc_generate_prefilled(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Decode-pool op: admit handed-off KV, decode to completion."""
         from ..engine.disagg import handoff_from_wire
@@ -1017,6 +1074,11 @@ class WorkerServer(FramedServerMixin):
                 self.fault_plan.injected_count(self._fault_scope())
                 if self.fault_plan is not None else 0),
             "handoff_bytes_shipped": self._handoff_bytes_shipped,
+            "kv_fabric_exports": self._kv_fabric_exports,
+            "kv_fabric_imports": self._kv_fabric_imports,
+            "kv_fabric_export_bytes": self._kv_fabric_export_bytes,
+            "kv_fabric_import_bytes": self._kv_fabric_import_bytes,
+            "kv_fabric_import_fallbacks": self._kv_fabric_import_fallbacks,
             "ping_count": self._ping_count,          # probes counted apart
             "active_connections": self._active_connections,
             "latency": self.latency.snapshot(),
@@ -1139,6 +1201,25 @@ class WorkerClient(FramedRPCClient):
     async def unload_model(self, name: str) -> bool:
         result = await self.call("unload_model", model=name)
         return bool(result["unloaded"])
+
+    async def kv_export(self, model: str, tokens: List[int],
+                        max_pages: int = 0,
+                        timeout: Optional[float] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """Fabric pull: the worker's wire dict for ``tokens``' longest
+        resident full-page prefix, or None when it holds nothing."""
+        result = await self.call(
+            "kv_export", model=model, tokens=[int(t) for t in tokens],
+            max_pages=int(max_pages), timeout=timeout)
+        return result.get("wire")
+
+    async def kv_import(self, model: str, wire: Dict[str, Any],
+                        timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Fabric push: land an exported wire in the worker's host tier.
+        Returns ``{imported_pages, rejected?}`` — a checksum/geometry
+        reject comes back typed in the payload, not as a transport error."""
+        return await self.call("kv_import", model=model, wire=wire,
+                               timeout=timeout)
 
     async def drain(self, timeout_s: float = 30.0) -> Dict[str, Any]:
         """Gracefully drain the worker: stop admission, wait for in-flight

@@ -1894,6 +1894,36 @@ class ContinuousEngine:
         hashes = page_chain_hashes(prompt, matchable, self.kv.page_size)
         return self.kv.prefetch_chain(hashes)
 
+    def kv_export(self, tokens, max_pages: int = 0):
+        """Serialize the longest locally-resident full-page prefix of
+        ``tokens`` as a KV-fabric wire dict (``engine/kv_fabric.py``), or
+        None when nothing is resident. Cold path — drain handoff and
+        coordinator pre-warm pulls, never the decode loop."""
+        if not self.prefix_cache:
+            return None
+        from .kv_fabric import export_paged_kv
+
+        prompt = list(tokens)[-(self.max_seq_len - 1):]
+        return export_paged_kv(self.kv, prompt, max_pages=max_pages)
+
+    def kv_import(self, wire) -> int:
+        """Validate a KV-fabric wire against the local pool, land its
+        pages in the HOST tier, and start the layer-wise host→device
+        restage. Returns pages newly stored. Raises ``FabricRejected``
+        with NOTHING stored on any mismatch — the caller falls back to
+        normal prefill, never serves wrong KV."""
+        from .kv_fabric import FabricRejected, import_paged_kv
+
+        if not self.prefix_cache or self._offload is None:
+            raise FabricRejected(
+                "worker has no prefix cache / host KV tier")
+        stored = import_paged_kv(self.kv, wire)
+        # kick the async restage now: per-layer staged device_puts overlap
+        # whatever the engine does until an admission consumes them (the
+        # prefetch-on-admit pump re-kicks for requests that arrive later)
+        self.kv.prefetch_chain([pg["hash"] for pg in wire.get("pages", [])])
+        return stored
+
     # --------------------------------------------------------------- step
 
     def _run_overlap_hook(self) -> None:

@@ -1,0 +1,239 @@
+"""Fleet-wide KV page fabric: wire format + import/export helpers.
+
+The r7 host tier (``HostKVOffload``) already holds exact KV pages in host
+RAM keyed by prefix-chain hash — but only worker-locally: an affinity
+rebind after drain/failover lands on a cold worker and mid-stream failover
+replays the whole prefix. This module extends those entries into a
+checksummed WIRE FORMAT that rides the framed RPC plane, so hot prefixes
+MIGRATE between workers instead of being recomputed (PRESERVE /
+async-KV-prefetch, PAPERS.md).
+
+Wire format (msgpack-native: str keys, ints, bytes — no pickling)::
+
+    {version: 1, kind: "paged",
+     page_size: P, dtype: "float32", layout: [L, P, fused],
+     pages: [{hash: <16B chain hash>, k: <raw bytes>, v: <raw bytes>,
+              checksum: blake2b(hash+k+v)}, ...],
+     manifest: blake2b(hash_0+checksum_0+...)}
+
+Commit/checksum protocol (r13 artifact discipline): every per-page
+checksum AND the manifest are verified BEFORE any page is stored —
+import is all-or-nothing, and a rejected import inserts NOTHING, so the
+importer falls back to normal prefill rather than ever serving wrong KV.
+The typed failure is ``FabricRejected``.
+
+Pages land in the importer's HOST tier (``offload.put``), never directly
+in the device pool: restage host→device rides the existing
+prefetch-on-admit path (``prefetch_chain`` → staged per-layer
+``device_put`` → ``alloc_slot_prefix`` host-hit → ``sync_tiers``
+scatter), so an import is bit-identical to a local offload/upload cycle
+and the r7 CPU-exact parity guarantees carry over unchanged.
+
+The fake engine speaks a parallel ``kind: "fake"`` wire (page-aligned
+prefix tokens + checksum) so fleet tests exercise the same RPC plane,
+validation, and fallback semantics without jax pools.
+
+Import-light on purpose (hashlib + numpy): the worker control plane loads
+this module for the typed error even when no jax engine is present;
+anything touching ``PagedKVCache`` imports jax lazily.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+WIRE_VERSION = 1
+KIND_PAGED = "paged"
+KIND_FAKE = "fake"
+
+
+class FabricRejected(ValueError):
+    """Typed import rejection: wrong version/kind, layout or dtype
+    mismatch, or a checksum failure. Guarantees NOTHING was stored — the
+    caller counts a fallback and serves via normal prefill."""
+
+
+# ----------------------------------------------------------- checksums
+
+def _page_checksum(h: bytes, k: bytes, v: bytes) -> bytes:
+    d = hashlib.blake2b(digest_size=16)
+    d.update(h)
+    d.update(k)
+    d.update(v)
+    return d.digest()
+
+
+def _manifest_checksum(pages: Sequence[Dict[str, Any]]) -> bytes:
+    d = hashlib.blake2b(digest_size=16)
+    for pg in pages:
+        d.update(pg.get("hash", b""))
+        d.update(pg.get("checksum", b""))
+    return d.digest()
+
+
+def token_checksum(tokens: Sequence[int]) -> bytes:
+    return hashlib.blake2b(
+        np.asarray(list(tokens), np.int64).tobytes(), digest_size=16
+    ).digest()
+
+
+def wire_nbytes(wire: Optional[Dict[str, Any]]) -> int:
+    """Payload size for accounting (page bytes, not framing overhead)."""
+    if not wire:
+        return 0
+    if wire.get("kind") == KIND_PAGED:
+        return sum(len(pg.get("k", b"")) + len(pg.get("v", b""))
+                   for pg in wire.get("pages", ()))
+    return 8 * len(wire.get("tokens", ()))
+
+
+# ------------------------------------------------------------ builders
+
+def build_paged_wire(page_size: int, dtype: str,
+                     layout: Sequence[int],
+                     pages: Sequence[Tuple[bytes, np.ndarray, np.ndarray]],
+                     ) -> Dict[str, Any]:
+    """Serialize (hash, k, v) host pages — ``[L, page_size, fused]``
+    each — into the checksummed wire dict."""
+    out: List[Dict[str, Any]] = []
+    for h, k_arr, v_arr in pages:
+        k_b = np.ascontiguousarray(k_arr).tobytes()
+        v_b = np.ascontiguousarray(v_arr).tobytes()
+        out.append({"hash": bytes(h), "k": k_b, "v": v_b,
+                    "checksum": _page_checksum(bytes(h), k_b, v_b)})
+    return {
+        "version": WIRE_VERSION,
+        "kind": KIND_PAGED,
+        "page_size": int(page_size),
+        "dtype": str(dtype),
+        "layout": [int(x) for x in layout],
+        "pages": out,
+        "manifest": _manifest_checksum(out),
+    }
+
+
+def build_fake_wire(tokens: Sequence[int], page_size: int) -> Dict[str, Any]:
+    toks = [int(t) for t in tokens]
+    return {
+        "version": WIRE_VERSION,
+        "kind": KIND_FAKE,
+        "page_size": int(page_size),
+        "tokens": toks,
+        "checksum": token_checksum(toks),
+    }
+
+
+# ---------------------------------------------------------- validation
+
+def _require(cond: bool, why: str) -> None:
+    if not cond:
+        raise FabricRejected(why)
+
+
+def check_paged_wire(wire: Any, *, page_size: int, dtype: str,
+                     layout: Sequence[int]) -> List[Dict[str, Any]]:
+    """Validate a paged wire against the local pool's geometry and verify
+    EVERY checksum; returns the page list. Raises ``FabricRejected``
+    without side effects on any mismatch."""
+    _require(isinstance(wire, dict), "wire is not a mapping")
+    _require(wire.get("version") == WIRE_VERSION,
+             f"wire version {wire.get('version')!r} != {WIRE_VERSION}")
+    _require(wire.get("kind") == KIND_PAGED,
+             f"wire kind {wire.get('kind')!r} != {KIND_PAGED!r}")
+    _require(int(wire.get("page_size", -1)) == int(page_size),
+             f"page_size {wire.get('page_size')!r} != local {page_size}")
+    _require(str(wire.get("dtype")) == str(dtype),
+             f"dtype {wire.get('dtype')!r} != local {dtype!r}")
+    got_layout = [int(x) for x in wire.get("layout", ())]
+    _require(got_layout == [int(x) for x in layout],
+             f"layout {got_layout} != local {[int(x) for x in layout]}")
+    pages = wire.get("pages")
+    _require(isinstance(pages, (list, tuple)) and len(pages) > 0,
+             "wire carries no pages")
+    for i, pg in enumerate(pages):
+        _require(isinstance(pg, dict), f"page {i} is not a mapping")
+        h, k_b, v_b = pg.get("hash"), pg.get("k"), pg.get("v")
+        _require(isinstance(h, bytes) and isinstance(k_b, bytes)
+                 and isinstance(v_b, bytes), f"page {i} fields not bytes")
+        _require(pg.get("checksum") == _page_checksum(h, k_b, v_b),
+                 f"page {i} checksum mismatch")
+    _require(wire.get("manifest") == _manifest_checksum(pages),
+             "manifest checksum mismatch")
+    return list(pages)
+
+
+def check_fake_wire(wire: Any, *, page_size: int) -> List[int]:
+    _require(isinstance(wire, dict), "wire is not a mapping")
+    _require(wire.get("version") == WIRE_VERSION,
+             f"wire version {wire.get('version')!r} != {WIRE_VERSION}")
+    _require(wire.get("kind") == KIND_FAKE,
+             f"wire kind {wire.get('kind')!r} != {KIND_FAKE!r}")
+    _require(int(wire.get("page_size", -1)) == int(page_size),
+             f"page_size {wire.get('page_size')!r} != local {page_size}")
+    toks = wire.get("tokens")
+    _require(isinstance(toks, (list, tuple)) and len(toks) > 0,
+             "wire carries no tokens")
+    toks = [int(t) for t in toks]
+    _require(len(toks) % int(page_size) == 0,
+             f"token count {len(toks)} not page-aligned to {page_size}")
+    _require(wire.get("checksum") == token_checksum(toks),
+             "token checksum mismatch")
+    return toks
+
+
+# -------------------------------------------- paged engine export/import
+
+def export_paged_kv(kv, tokens: Sequence[int],
+                    max_pages: int = 0) -> Optional[Dict[str, Any]]:
+    """Export the longest resident full-page prefix of ``tokens`` from a
+    ``PagedKVCache`` (device index, pending uploads, or host tier) as a
+    wire dict; None when nothing is resident."""
+    from .paged_kv import page_chain_hashes  # lazy: pulls jax
+
+    toks = [int(t) for t in tokens]
+    n_full = len(toks) // kv.page_size
+    if max_pages > 0:
+        n_full = min(n_full, int(max_pages))
+    if n_full < 1:
+        return None
+    hashes = page_chain_hashes(toks, n_full, kv.page_size)
+    pages = kv.export_prefix_pages(hashes)
+    if not pages:
+        return None
+    n_layers, _, p, fused = kv.k_pages.shape
+    return build_paged_wire(kv.page_size, str(kv.dtype),
+                            (n_layers, p, fused), pages)
+
+
+def import_paged_kv(kv, wire: Any) -> int:
+    """Validate ``wire`` against the local pool and land its pages in the
+    HOST tier. Returns how many pages were newly stored (already-resident
+    pages are skipped — the local copy is authoritative). All checksums
+    verify before the first ``put``; any failure raises ``FabricRejected``
+    with nothing stored."""
+    _require(kv.offload is not None,
+             "importer has no host KV tier (kv_offload_bytes=0)")
+    n_layers, _, p, fused = kv.k_pages.shape
+    pages = check_paged_wire(wire, page_size=kv.page_size,
+                             dtype=str(kv.dtype),
+                             layout=(n_layers, p, fused))
+    expect = n_layers * p * fused * kv.dtype.itemsize
+    for i, pg in enumerate(pages):
+        _require(len(pg["k"]) == expect and len(pg["v"]) == expect,
+                 f"page {i} payload is {len(pg['k'])}+{len(pg['v'])} bytes, "
+                 f"layout implies {expect}")
+    stored = 0
+    for pg in pages:
+        h = pg["hash"]
+        if kv.holds_prefix_page(h):
+            continue
+        k_arr = np.frombuffer(pg["k"], dtype=kv.dtype).reshape(
+            n_layers, p, fused)
+        v_arr = np.frombuffer(pg["v"], dtype=kv.dtype).reshape(
+            n_layers, p, fused)
+        if kv.offload.put(h, k_arr, v_arr):
+            stored += 1
+    return stored

@@ -26,6 +26,7 @@ staged uploads. Transfers INTO the store are batched by the cache's
 from __future__ import annotations
 
 import collections
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -48,8 +49,15 @@ class _Entry:
 class HostKVOffload:
     """Byte-budgeted host LRU of KV pages, keyed by page-chain hash."""
 
-    def __init__(self, max_bytes: int = 1 << 30) -> None:
+    def __init__(self, max_bytes: int = 1 << 30,
+                 upload_layers_per_chunk: int = 1) -> None:
         self.max_bytes = int(max_bytes)
+        # layer-wise staging granularity: start_upload issues one async
+        # device_put per chunk of this many layers (PRESERVE-style overlap
+        # — each chunk's PCIe copy is in flight while the next is sliced),
+        # and the sync_tiers scatter concatenates on device. 0 = whole-page
+        # single device_put (the pre-fabric behavior).
+        self.upload_layers_per_chunk = int(upload_layers_per_chunk)
         self._entries: "collections.OrderedDict[bytes, _Entry]" = (
             collections.OrderedDict()
         )
@@ -62,6 +70,11 @@ class HostKVOffload:
         self._staged_pages = 0
         self._evicted_pages = 0
         self._rejected_pages = 0
+        # restage overlap: wall-clock between start_upload (prefetch) and
+        # the get() that consumes the staged copy — the window the async
+        # host→device transfer had to hide behind queue wait / decode
+        self._staged_at: Dict[bytes, float] = {}
+        self._restage_overlap_s = 0.0
 
     # --------------------------------------------------------------- LRU
 
@@ -112,26 +125,51 @@ class HostKVOffload:
         self._hit_pages += 1
         self._hit_bytes += entry.nbytes
         if entry.k_dev is not None:
+            t0 = self._staged_at.pop(key, None)
+            if t0 is not None:
+                self._restage_overlap_s += time.perf_counter() - t0
             return entry.k_dev, entry.v_dev
+        return entry.k, entry.v
+
+    def peek(self, key: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Host copy of a page's (k, v) WITHOUT recency touch or hit
+        accounting — the KV-fabric export reader (an export must not
+        perturb the LRU the serving path depends on)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
         return entry.k, entry.v
 
     def start_upload(self, key: bytes) -> bool:
         """Begin an async host→device copy of the entry (non-blocking:
         ``device_put`` returns immediately; the transfer overlaps whatever
-        the engine does until admission consumes it via ``get``)."""
+        the engine does until admission consumes it via ``get``). With
+        ``upload_layers_per_chunk > 0`` the copy is issued as per-layer-
+        chunk device_puts — each chunk's transfer is dispatched while the
+        next is sliced, and the staged value is a list of device chunks
+        that ``sync_tiers`` concatenates on device."""
         entry = self._entries.get(key)
         if entry is None:
             return False
         if entry.k_dev is None:
-            entry.k_dev = jax.device_put(entry.k)
-            entry.v_dev = jax.device_put(entry.v)
+            step = self.upload_layers_per_chunk
+            if step > 0 and entry.k.shape[0] > step:
+                entry.k_dev = [jax.device_put(entry.k[i:i + step])
+                               for i in range(0, entry.k.shape[0], step)]
+                entry.v_dev = [jax.device_put(entry.v[i:i + step])
+                               for i in range(0, entry.v.shape[0], step)]
+            else:
+                entry.k_dev = jax.device_put(entry.k)
+                entry.v_dev = jax.device_put(entry.v)
             self._staged_pages += 1
+            self._staged_at[key] = time.perf_counter()
         return True
 
     def _evict_oldest(self) -> None:
-        _, entry = self._entries.popitem(last=False)
+        key, entry = self._entries.popitem(last=False)
         self._lru_bytes -= entry.nbytes
         self._evicted_pages += 1
+        self._staged_at.pop(key, None)
 
     # -------------------------------------------------- swap reservations
 
@@ -165,4 +203,5 @@ class HostKVOffload:
             "host_staged_pages": self._staged_pages,
             "host_evicted_pages": self._evicted_pages,
             "host_rejected_pages": self._rejected_pages,
+            "restage_overlap_s": self._restage_overlap_s,
         }

@@ -52,6 +52,34 @@ class OutOfPagesError(RuntimeError):
     """Pool exhausted — the scheduler must queue or preempt."""
 
 
+def _stage_value(val, dtype):
+    """Coerce one staged page value to a device array for the upload
+    scatter: plain host/device arrays pass through; per-layer-chunk lists
+    (the layer-wise prefetch staging in ``HostKVOffload.start_upload``)
+    concatenate on device — ordered slices of one array concatenated back
+    are bit-identical to the whole array."""
+    if isinstance(val, (list, tuple)):
+        return jnp.concatenate([jnp.asarray(c, dtype) for c in val], axis=0)
+    return jnp.asarray(val, dtype)
+
+
+def _value_nbytes(val) -> int:
+    """Byte size of one staged page value (array or per-layer-chunk list)."""
+    if isinstance(val, (list, tuple)):
+        return sum(int(c.nbytes) for c in val)
+    return int(val.nbytes)
+
+
+def _host_page(val) -> np.ndarray:
+    """One page value → contiguous host array (KV-fabric export). Accepts
+    host arrays, staged device arrays, or per-layer-chunk lists."""
+    if isinstance(val, (list, tuple)):
+        # graftlint: ok[host-sync-hot-path] fabric export (drain/pre-warm RPC), never the decode hot path
+        return np.concatenate([np.asarray(c) for c in val], axis=0)
+    # graftlint: ok[host-sync-hot-path] fabric export (drain/pre-warm RPC), never the decode hot path
+    return np.ascontiguousarray(np.asarray(val))
+
+
 def page_chain_hashes(tokens, n_pages: int, page_size: int) -> List[bytes]:
     """Chain hashes for the first ``n_pages`` FULL pages of ``tokens``:
     hash_i commits to tokens[0 : (i+1)·P], so a hit is an exact-prefix
@@ -441,6 +469,45 @@ class PagedKVCache:
         self._host_hit_tokens += len(host_hits) * self.page_size
         return slot, n_cached
 
+    def holds_prefix_page(self, h: bytes) -> bool:
+        """Is this chain hash resident locally (device index or host
+        tier)? No recency touch — advisory, for import dedup."""
+        return (h in self._prefix_index
+                or (self.offload is not None and self.offload.probe(h)))
+
+    def export_prefix_pages(self, hashes: List[bytes]
+                            ) -> List[Tuple[bytes, np.ndarray, np.ndarray]]:
+        """Host copies of the longest LEADING run of resident pages, in
+        chain order — the KV-fabric export reader. Pages are sourced from
+        wherever the authoritative bytes live: a pending-upload staged
+        value (device copy not yet scattered), the device pool (one
+        batched read for all such pages), or the host tier (``peek``: no
+        recency touch, so an export never perturbs the serving LRU).
+        Returns ``[(hash, k, v), ...]`` with ``[L, page_size, fused]``
+        host arrays."""
+        spec: List[Tuple[bytes, object, Optional[int]]] = []
+        for h in hashes:
+            page = self._prefix_index.get(h)
+            if page is not None:
+                spec.append((h, self._pending_upload.get(page), page))
+                continue
+            if self.offload is not None:
+                got = self.offload.peek(h)
+                if got is not None:
+                    spec.append((h, got, None))
+                    continue
+            break
+        dev = [page for _, pend, page in spec
+               if pend is None and page is not None]
+        dev_map: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        if dev:
+            ks, vs = self.read_pages(dev)
+            dev_map = {p: (k, v) for p, k, v in zip(dev, ks, vs)}
+        return [(h,
+                 _host_page(pend[0] if pend is not None else dev_map[page][0]),
+                 _host_page(pend[1] if pend is not None else dev_map[page][1]))
+                for h, pend, page in spec]
+
     def register_prefix(self, slot: int, tokens) -> int:
         """Index this slot's full prompt pages for future reuse; returns
         how many pages were newly registered. Call after the prompt KV is
@@ -545,15 +612,15 @@ class PagedKVCache:
             self._pending_upload.clear()
             n = len(items)
             self._upload_bytes += sum(
-                int(k_arr.nbytes) + int(v_arr.nbytes) for _, (k_arr, v_arr)
-                in items)
+                _value_nbytes(k_arr) + _value_nbytes(v_arr)
+                for _, (k_arr, v_arr) in items)
             bucket = 1 << max(0, n - 1).bit_length()
             items.extend([items[-1]] * (bucket - n))  # identical dup writes
             ids = jnp.asarray(np.asarray([p for p, _ in items], np.int32))
             k_vals = jnp.stack(
-                [jnp.asarray(kv[0], self.dtype) for _, kv in items], axis=1)
+                [_stage_value(kv[0], self.dtype) for _, kv in items], axis=1)
             v_vals = jnp.stack(
-                [jnp.asarray(kv[1], self.dtype) for _, kv in items], axis=1)
+                [_stage_value(kv[1], self.dtype) for _, kv in items], axis=1)
             self.k_pages, self.v_pages = _scatter_pages(
                 self.k_pages, self.v_pages, ids, k_vals, v_vals)
 

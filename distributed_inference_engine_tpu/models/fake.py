@@ -152,6 +152,9 @@ class FakeContinuousEngine:
         self._prefix_seen: set = set()
         self._prefix_cached_tokens = 0
         self._admit_sleep_s = 0.0
+        self._fabric_exports = 0
+        self._fabric_imports = 0
+        self._fabric_imported_tokens = 0
         # waiting: (request, on_tokens, t_submit); live: [req, cb, t_submit,
         # chain state, tokens]
         self._waiting: List[tuple] = []
@@ -261,6 +264,49 @@ class FakeContinuousEngine:
         cached = warm_pages * page
         self._prefix_cached_tokens += cached
         return len(prompt) - cached
+
+    # ---------------------------------------------------------- KV fabric
+
+    def kv_export(self, tokens, max_pages: int = 0):
+        """Fake-flavored KV-fabric export (``kind: "fake"`` wire,
+        engine/kv_fabric.py): the longest page-aligned prefix of
+        ``tokens`` this engine has admitted, as tokens + checksum. Speaks
+        the same RPC plane / validation / fallback protocol as the real
+        engine so fleet tests exercise the fabric without jax pools."""
+        from ..engine.kv_fabric import build_fake_wire
+
+        if not self.prefix_cache:
+            return None
+        toks = [int(t) for t in tokens]
+        page = self.prefix_page_size
+        full_pages = len(toks) // page
+        if max_pages > 0:
+            full_pages = min(full_pages, int(max_pages))
+        for j in range(full_pages, 0, -1):
+            if tuple(toks[:j * page]) in self._prefix_seen:
+                self._fabric_exports += 1
+                return build_fake_wire(toks[:j * page], page)
+        return None
+
+    def kv_import(self, wire) -> int:
+        """Validate + admit an exported prefix as locally warm; returns
+        pages imported. ``FabricRejected`` (nothing admitted) on any
+        mismatch — admission then pays normal prefill, never wrong KV."""
+        from ..engine.kv_fabric import FabricRejected, check_fake_wire
+
+        if not self.prefix_cache:
+            raise FabricRejected("importer has no prefix cache")
+        page = self.prefix_page_size
+        toks = check_fake_wire(wire, page_size=page)
+        imported = 0
+        for j in range(1, len(toks) // page + 1):
+            head = tuple(toks[:j * page])
+            if head not in self._prefix_seen:
+                self._prefix_seen.add(head)
+                imported += 1
+        self._fabric_imports += 1
+        self._fabric_imported_tokens += imported * page
+        return imported
 
     def step(self) -> int:
         """One decode step for every live slot (admitting from the waiting
@@ -394,6 +440,9 @@ class FakeContinuousEngine:
             "prefilled_admitted": self._prefilled_admitted,
             "prefix_cached_tokens": self._prefix_cached_tokens,
             "admit_sleep_s": self._admit_sleep_s,
+            "fabric_exports": self._fabric_exports,
+            "fabric_imports": self._fabric_imports,
+            "fabric_imported_tokens": self._fabric_imported_tokens,
             "ttft": self.ttft_stats.snapshot(),
             "decode_chunk": self.step_stats.snapshot(),
             "spec": {"fake": True, "continuous": True},

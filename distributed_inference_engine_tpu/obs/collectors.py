@@ -169,6 +169,9 @@ OFFLOAD_TABLE = [                  # kv get_stats()["host_tier"]
      "Device to host copies queued for the next sync"),
     ("pending_upload", "offload_pending_upload", "g",
      "Host to device uploads in flight"),
+    ("restage_overlap_s", "kv_fabric_restage_overlap_seconds", "c",
+     "Seconds host-to-device restaging ran overlapped (staged layer-wise "
+     "at prefetch, consumed at admission)"),
 ]
 
 PUMP_TABLE = [                     # EnginePump.get_stats() (sans "engine")
@@ -278,6 +281,8 @@ COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
      "Requests shed at coordinator admission (fleet-level degradation)"),
     ("admission_shed_active", "coordinator_admission_shed_active", "g",
      "1 while fleet-level admission shedding is engaged"),
+    ("kv_fabric_prewarm_pushes", "kv_fabric_prewarm_pushes", "c",
+     "Prefix wires pushed into workers before half-open rejoin"),
 ]
 
 AUTOSCALER_TABLE = [               # FleetAutoscaler.get_stats()
@@ -321,6 +326,16 @@ WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
      "Chaos faults injected into this worker's server plane"),
     ("handoff_bytes_shipped", "worker_handoff_bytes_shipped", "c",
      "Disaggregated KV handoff bytes sent to decode peers"),
+    ("kv_fabric_exports", "kv_fabric_exports", "c",
+     "kv_export RPCs that produced a prefix wire"),
+    ("kv_fabric_imports", "kv_fabric_imports", "c",
+     "kv_import RPCs that landed pages in the host KV tier"),
+    ("kv_fabric_export_bytes", "kv_fabric_export_bytes", "c",
+     "KV page payload bytes exported over the fabric"),
+    ("kv_fabric_import_bytes", "kv_fabric_import_bytes", "c",
+     "KV page payload bytes imported over the fabric"),
+    ("kv_fabric_import_fallbacks", "kv_fabric_import_fallbacks", "c",
+     "Imports rejected (checksum/shape) — worker falls back to prefill"),
     ("ping_count", "worker_pings", "c", "Health probes answered"),
     ("active_connections", "worker_active_connections", "g",
      "Open RPC connections"),

@@ -26,6 +26,16 @@ after a failover — must produce the same stream):
   kill        N=4 under load, one worker hard-killed mid-run, supervisor
               auto-respawns it (restart hook), retries+failover carry the
               in-flight work. Acceptance: ≥ 99% of requests token-exact.
+  kvfabric    N=3 with the KV fabric on: a shared 256-token system prompt
+              is cold-prefilled by exactly ONE worker; the coordinator
+              pre-warms the other replicas over kv_export/kv_import, and a
+              spread workload (distinct routing keys) proves every worker
+              serves the prefix warm (fleet admit-sleep budget fits one
+              cold prefill). Then the bound worker is hard-killed
+              mid-stream: failover imports the cached wire into the
+              alternate and hands the binding over. Acceptance: 100%
+              token-exact, resumed TTFT ≤ 2x the affinity-hit TTFT, and
+              two same-seed runs produce identical token receipts.
   autoscale   the SLO loop closed (cluster/autoscaler.py): fleet starts at
               BENCH_FLEET_MIN under easy load, offered load jumps to
               BENCH_FLEET_BURST× one worker's capacity mid-run — the
@@ -65,6 +75,7 @@ import json
 import os
 import sys
 import time
+import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -683,15 +694,207 @@ async def leg_tiny():
     return rows
 
 
+async def _fabric_worker_metrics(coord, model="m"):
+    """Per-worker engine + kv_fabric_* counters (worker metrics RPC)."""
+    out = {}
+    for wid in list(coord.router.workers):
+        try:
+            m = await coord.router.client_for(wid).metrics()
+        except Exception:
+            continue
+        eng = dict(m.get("models", {}).get(model, {}))
+        eng.update({k: v for k, v in m.items()
+                    if k.startswith("kv_fabric_")})
+        out[wid] = eng
+    return out
+
+
+async def _kvfabric_once(seed, run_tag):
+    """One seeded pass of the kvfabric leg. Returns (rows, receipt) where
+    the receipt is the canonical (tag, tokens) ledger — two same-seed
+    passes must produce identical receipts."""
+    n = 3
+    page = 64
+    lat = 2e-3  # cold admission: 2 ms per uncached prompt token
+    sys_prefix = [int(t) for t in
+                  np.random.RandomState(seed).randint(1, VOCAB, 4 * page)]
+    nt = bench.FLEET_NEW_TOKENS
+    cfg = fake_cfg(prefix_cache=1, prefix_page_size=page,
+                   admit_latency_per_token_s=lat)
+    coord_cfg = CoordinatorConfig(
+        # affinity_pages covers the FULL system prompt: the fabric
+        # migrates the prefix the affinity router tracks, so the wire
+        # must span all four pages for the one-cold-prefill budget
+        lb_strategy="prefix_affinity", affinity_page_size=page,
+        affinity_pages=4, retry_seed=seed, retry_backoff_base_s=0.01,
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1)
+    coord, workers = await start_fleet(n, coord_cfg=coord_cfg)
+    spawned = []
+    coord.start_supervisor(_spawner(spawned))
+    await coord.deploy_model(cfg, register_shards=False)
+    receipt, rows = [], []
+    try:
+        # -- phase 1: ONE cold prefill fleet-wide, then fabric pre-warm.
+        # The warm-up request binds the shared system prompt to one worker
+        # and pays the only cold admission of the whole leg; every other
+        # worker receives the pages over the fabric instead.
+        p0 = sys_prefix + [1, 0]
+        r = await coord.submit("m", prompt=p0, max_new_tokens=nt,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(p0, nt), "warm-up diverged"
+        ttft_cold = float(r["ttft_s"])
+        receipt.append(("warmup", tuple(r["tokens"])))
+        origin = next(iter(coord.lb._affinity.values()))
+        for _ in range(200):  # background snapshot → coordinator wire cache
+            if coord.get_stats()["kv_fabric_cached_wires"] >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert coord.get_stats()["kv_fabric_cached_wires"] >= 1, \
+            "fabric snapshot never landed"
+        prewarmed = 0
+        for wid in workers:
+            if wid != origin:
+                prewarmed += await coord.prewarm_worker(wid)
+        assert prewarmed == n - 1, \
+            f"pre-warm landed on {prewarmed}/{n - 1} workers"
+
+        # -- phase 2: shared-system-prompt spread. Distinct routing keys
+        # force the requests across ALL workers; each must admit the
+        # shared prefix warm off its imported copy.
+        sleep0 = sum(m.get("admit_sleep_s", 0.0) for m in
+                     (await _fabric_worker_metrics(coord)).values())
+        gen0 = await worker_generated(coord)
+        spread = [sys_prefix + [2, j] for j in range(4 * n)]
+        t0 = time.perf_counter()
+        s_res = await asyncio.gather(*[
+            coord.submit("m", prompt=p, max_new_tokens=nt, key=f"s{j}",
+                         no_cache=True)
+            for j, p in enumerate(spread)], return_exceptions=True)
+        wall = time.perf_counter() - t0
+        ok, toks = score(spread, s_res, nt)
+        assert ok == len(spread), f"spread phase: {ok}/{len(spread)} exact"
+        receipt += [(f"spread{j}", tuple(r["tokens"]))
+                    for j, r in enumerate(s_res)]
+        gen1 = await worker_generated(coord)
+        wm = await _fabric_worker_metrics(coord)
+        served = {wid: gen1[wid]["generated"]
+                  - gen0.get(wid, {"generated": 0})["generated"]
+                  for wid in gen1}
+        assert all(v > 0 for v in served.values()), \
+            f"a worker served nothing: {served}"
+        for wid, m in wm.items():
+            if wid != origin:
+                assert m.get("fabric_imports", 0) >= 1, \
+                    f"{wid} never imported over the fabric"
+        # the fleet-wide cold-admission bill must fit ONE prefix prefill
+        # plus the per-request uncached tails — a second cold prefill
+        # anywhere would blow the budget by ~prefix_len * lat
+        sleep1 = sum(m.get("admit_sleep_s", 0.0) for m in wm.values())
+        uncached_budget = lat * (len(sys_prefix) + 2 * (len(spread) + 1))
+        assert sleep1 - 0.0 <= uncached_budget * 1.25 + 0.05, \
+            f"prefix cold-prefilled more than once fleet-wide " \
+            f"(admit sleep {sleep1:.3f}s > budget {uncached_budget:.3f}s)"
+        ttfts = [float(r["ttft_s"]) for r in s_res if isinstance(r, dict)]
+        rows.append(emit({
+            "leg": "kvfabric_prewarm", "run": run_tag, "workers": n,
+            "requests": len(spread), "token_exact": ok,
+            "token_exact_frac": round(ok / len(spread), 4),
+            "goodput_toks": round(toks / wall, 1),
+            "ttft_cold_ms": round(ttft_cold * 1e3, 1),
+            "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 1),
+            "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 1),
+            "prewarm_pushes": prewarmed,
+            "fleet_admit_sleep_s": round(sleep1, 3),
+            "served_per_worker": served, "wall_s": round(wall, 2)}))
+
+        # -- phase 3: mid-stream kill of the bound worker. The failover
+        # path imports the dead stream's cached wire into the alternate
+        # and hands the binding over, so resumed TTFT stays warm.
+        kill_prompts = [sys_prefix + [3, j] for j in range(18)]
+        rate = 30.0
+
+        async def sabotage():
+            log(f"  !! hard-killing bound worker {origin} mid-stream")
+            await workers.pop(origin).stop()
+
+        k_res, k_wall, _, _ = await drive(
+            coord, kill_prompts, rate, nt, seed + 1,
+            mid_load_hook=sabotage)
+        ok_k, toks_k = score(kill_prompts, k_res, nt)
+        assert ok_k == len(kill_prompts), \
+            f"kill phase: {ok_k}/{len(kill_prompts)} exact"
+        receipt += [(f"kill{j}", tuple(r["tokens"]))
+                    for j, r in enumerate(k_res)]
+        fire_at = len(kill_prompts) // 3
+        warm = [float(r["ttft_s"]) for r in k_res[:fire_at]
+                if isinstance(r, dict)]
+        resumed = [float(r["ttft_s"]) for r in k_res[fire_at:]
+                   if isinstance(r, dict)]
+        ratio = pct(resumed, 0.5) / max(pct(warm, 0.5), 1e-9)
+        for _ in range(100):
+            if coord.get_stats()["supervisor_respawns"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        st = coord.get_stats()
+        rows.append(emit({
+            "leg": "kvfabric_kill", "run": run_tag, "workers": n,
+            "requests": len(kill_prompts), "token_exact": ok_k,
+            "token_exact_frac": round(ok_k / len(kill_prompts), 4),
+            "goodput_toks": round(toks_k / k_wall, 1),
+            "ttft_warm_p50_ms": round(pct(warm, 0.5) * 1e3, 1),
+            "ttft_resumed_p50_ms": round(pct(resumed, 0.5) * 1e3, 1),
+            "resumed_over_warm": round(ratio, 2),
+            "failover_imports": st["kv_fabric_failover_imports"],
+            "prewarm_pushes_total": st["kv_fabric_prewarm_pushes"],
+            "supervisor_respawns": st["supervisor_respawns"],
+            "wall_s": round(k_wall, 2)}))
+        assert ratio <= 2.0, \
+            f"resumed TTFT {ratio:.2f}x warm (acceptance <= 2x)"
+    finally:
+        await stop_fleet(coord, workers)
+        for w in spawned:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+    return rows, receipt
+
+
+async def leg_kvfabric():
+    """KV fabric leg: shared-system-prompt fleet where the prefix is
+    prefilled locally at most once fleet-wide (everyone else imports it),
+    plus a mid-stream kill whose resumed TTFT must stay within 2x the
+    affinity-hit TTFT. Runs TWICE with the same seed — the token receipts
+    must be identical."""
+    rows_a, receipt_a = await _kvfabric_once(bench.FLEET_SEED, "a")
+    rows_b, receipt_b = await _kvfabric_once(bench.FLEET_SEED, "b")
+    assert receipt_a == receipt_b, \
+        "same-seed kvfabric runs produced different token receipts"
+    h = zlib.crc32(repr(receipt_a).encode()) & 0xFFFFFFFF
+    log(f"  kvfabric: receipts identical across same-seed runs "
+        f"(crc32 {h:#010x}), resumed TTFT "
+        f"{rows_a[1]['resumed_over_warm']}x warm (acceptance <= 2x)")
+    rows = rows_a + rows_b
+    rows.append(emit({"leg": "kvfabric", "summary": True,
+                      "receipt_crc32": h, "receipts_identical": True,
+                      "resumed_over_warm": rows_a[1]["resumed_over_warm"]}))
+    dump_leg("kvfabric", rows)
+    return rows
+
+
 LEGS = {"replicated": leg_replicated, "disagg": leg_disagg,
         "affinity": leg_affinity, "kill": leg_kill,
+        "kvfabric": leg_kvfabric,
         "autoscale": leg_autoscale, "upgrade": leg_upgrade}
 
 
 async def main_async():
     want = [s for s in os.environ.get(
         "SWEEP_LEGS",
-        "replicated,disagg,affinity,kill,autoscale,upgrade,tiny"
+        "replicated,disagg,affinity,kill,kvfabric,autoscale,upgrade,tiny"
     ).split(",") if s]
     all_rows = []
     for name in want:

@@ -114,6 +114,19 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2g: fast KV-fabric leg -------------------------------------
+# fleet-wide KV page migration (-m fabric): export/import wire
+# bit-parity across KV dtypes, checksum rejection, pre-warm-before-
+# half-open ordering, failover import, fault fallback.
+echo "== kv fabric (-m 'fabric and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'fabric and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: kv fabric leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.

@@ -71,6 +71,11 @@ def pytest_configure(config):
         "rebind on drain/respawn/failover; fast leg: pytest -m 'fleet "
         "and not slow')")
     config.addinivalue_line(
+        "markers", "fabric: KV fabric tests (export/import wire bit-parity "
+        "across KV dtypes, checksum rejection, pre-warm-before-half-open, "
+        "failover import, fault fallback; fast leg: pytest -m 'fabric and "
+        "not slow')")
+    config.addinivalue_line(
         "markers", "autoscale: SLO-driven autoscaling and rolling-upgrade "
         "tests (policy hysteresis/cooldown/guards, decision-ledger "
         "determinism, drain→swap→probe→rejoin, fleet admission shed; "

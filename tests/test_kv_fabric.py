@@ -1,0 +1,487 @@
+"""KV fabric tests (-m fabric; engine/kv_fabric.py + the kv_export /
+kv_import RPC plane + the coordinator's migration triggers).
+
+Correctness bar, same as the r7 host tier: an IMPORTED page must be
+bit-identical to a locally-prefilled one (asserted across float32 /
+bfloat16 / fp8 KV), every checksum must verify before anything is
+stored (a rejected import inserts NOTHING and admission falls back to
+normal prefill), and the coordinator must pre-warm BEFORE half-open so
+a rejoining worker's trial probe lands on imported KV.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_inference_engine_tpu.api.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+from distributed_inference_engine_tpu.config import (
+    EngineConfig,
+    HealthConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.engine.continuous import ContinuousEngine
+from distributed_inference_engine_tpu.engine.kv_fabric import (
+    FabricRejected,
+    build_fake_wire,
+    check_fake_wire,
+    wire_nbytes,
+)
+from distributed_inference_engine_tpu.engine.kv_offload import HostKVOffload
+from distributed_inference_engine_tpu.engine.types import GenerationRequest
+from distributed_inference_engine_tpu.models.base import init_params
+from distributed_inference_engine_tpu.models.fake import _chain
+from distributed_inference_engine_tpu.models.llama import llama_spec
+from distributed_inference_engine_tpu.utils.faults import (
+    SERVER,
+    FaultPlan,
+    FaultSpec,
+)
+
+pytestmark = pytest.mark.fabric
+
+SPEC = llama_spec("llama-tiny", max_seq_len=128)
+PAGE = 8
+SYS = list(range(1, 25))          # 24 tokens = 3 full pages
+PROMPT = SYS + [30, 31]
+
+
+def _cfg(kv_dtype="float32", **over):
+    base = dict(max_slots=4, max_seq_len=128, page_size=PAGE,
+                num_pages=16, decode_steps_per_call=4,
+                attention_impl="xla", prefix_cache=True,
+                kv_dtype=kv_dtype, kv_offload=True)
+    base.update(over)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(SPEC, jax.random.key(0))
+
+
+def _req(rid="r", prompt=None, max_new=6):
+    return GenerationRequest(prompt=list(prompt or PROMPT),
+                             max_new_tokens=max_new, temperature=0.0,
+                             request_id=rid)
+
+
+# ------------------------------------------------------- wire unit tests
+
+
+def test_fake_wire_roundtrip_and_rejects():
+    w = build_fake_wire([1, 2, 3, 4], page_size=2)
+    assert check_fake_wire(w, page_size=2) == [1, 2, 3, 4]
+    assert wire_nbytes(w) == 4 * 8
+    with pytest.raises(FabricRejected):
+        check_fake_wire(w, page_size=4)          # geometry mismatch
+    bad = dict(w)
+    bad["tokens"] = [1, 2, 3, 5]                 # payload tampered
+    with pytest.raises(FabricRejected):
+        check_fake_wire(bad, page_size=2)
+    misaligned = build_fake_wire([1, 2, 3], page_size=2)
+    with pytest.raises(FabricRejected):
+        check_fake_wire(misaligned, page_size=2)
+    with pytest.raises(FabricRejected):
+        check_fake_wire({"kind": "fake"}, page_size=2)
+
+
+def test_host_store_stages_layerwise_chunks_bit_exact():
+    """upload_layers_per_chunk=1 staging splits the page into per-layer
+    device_put chunks; concatenated they are bit-identical to the host
+    array, and consuming a staged entry accounts restage overlap."""
+    store = HostKVOffload(max_bytes=1 << 20)
+    k = np.arange(4 * PAGE * 16, dtype=np.float32).reshape(4, PAGE, 16)
+    v = -k
+    assert store.put(b"h", k, v)
+    assert store.start_upload(b"h")
+    got_k, got_v = store.get(b"h")
+    assert isinstance(got_k, list) and len(got_k) == 4
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(c) for c in got_k], axis=0), k)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(c) for c in got_v], axis=0), v)
+    assert store.get_stats()["restage_overlap_s"] > 0.0
+
+
+# ------------------------------------------- paged export/import parity
+
+
+@pytest.mark.parametrize("kv_dtype",
+                         ["float32", "bfloat16", "float8_e4m3fn"])
+def test_export_import_bit_parity_across_kv_dtypes(params, kv_dtype):
+    """The tentpole invariant: pages exported from one engine and
+    imported into another are bit-identical to locally-prefilled pages,
+    and the importer admits them from its host tier (no recompute) with
+    token-exact generation — for every KV dtype, including quantized."""
+    a = ContinuousEngine(SPEC, params=params, config=_cfg(kv_dtype))
+    want = a.generate([_req("a1")])[0].tokens
+    wire_a = a.kv_export(PROMPT)
+    assert wire_a is not None and len(wire_a["pages"]) == 3
+    assert wire_a["dtype"] == kv_dtype
+
+    # an independent engine prefilling the same prompt exports the SAME
+    # bytes: imported == locally-prefilled, bit for bit
+    c = ContinuousEngine(SPEC, params=params, config=_cfg(kv_dtype))
+    c.generate([_req("c1")])
+    wire_c = c.kv_export(PROMPT)
+    assert [(p["hash"], p["k"], p["v"]) for p in wire_a["pages"]] == \
+        [(p["hash"], p["k"], p["v"]) for p in wire_c["pages"]]
+
+    b = ContinuousEngine(SPEC, params=params, config=_cfg(kv_dtype))
+    assert b.kv_import(wire_a) == 3
+    # the host tier holds exactly the wire's bytes
+    for pg in wire_a["pages"]:
+        k_arr, v_arr = b.kv.offload.peek(pg["hash"])
+        assert k_arr.tobytes() == pg["k"] and v_arr.tobytes() == pg["v"]
+    got = b.generate([_req("b1")])[0].tokens
+    assert got == want
+    host = b.get_metrics()["kv"]["host_tier"]
+    assert host["host_hit_pages_admit"] == 3      # admitted, not recomputed
+    # kv_import prefetched the chain: the host→device restage ran
+    # overlapped (staged layer-wise at import, consumed at admission)
+    assert host["restage_overlap_s"] > 0.0
+    # a re-export from the importer round-trips the same bytes
+    wire_b = b.kv_export(PROMPT)
+    assert [(p["hash"], p["k"], p["v"]) for p in wire_b["pages"]] == \
+        [(p["hash"], p["k"], p["v"]) for p in wire_a["pages"]]
+
+
+def test_import_checksum_reject_stores_nothing(params):
+    """A corrupted wire must be rejected as a whole — no partial pages in
+    the host tier — and the importer still serves token-exact via the
+    normal cold prefill fallback."""
+    a = ContinuousEngine(SPEC, params=params, config=_cfg())
+    want = a.generate([_req("a1")])[0].tokens
+    wire = a.kv_export(PROMPT)
+
+    def tampered(mutate):
+        bad = {k: v for k, v in wire.items()}
+        bad["pages"] = [dict(p) for p in wire["pages"]]
+        mutate(bad)
+        return bad
+
+    flip = tampered(lambda w: w["pages"][1].update(
+        k=b"\xff" + w["pages"][1]["k"][1:]))
+    b = ContinuousEngine(SPEC, params=params, config=_cfg())
+    with pytest.raises(FabricRejected):
+        b.kv_import(flip)
+    with pytest.raises(FabricRejected):          # manifest covers the set
+        b.kv_import(tampered(lambda w: w["pages"].pop()))
+    with pytest.raises(FabricRejected):          # geometry must match
+        b.kv_import(tampered(lambda w: w.update(page_size=PAGE * 2)))
+    # dtype mismatch: a bf16 wire never lands in a float32 pool
+    bf = ContinuousEngine(SPEC, params=params, config=_cfg("bfloat16"))
+    bf.generate([_req("bf1")])
+    with pytest.raises(FabricRejected):
+        b.kv_import(bf.kv_export(PROMPT))
+    assert len(b.kv.offload) == 0                # nothing ever stored
+    assert b.generate([_req("b1")])[0].tokens == want
+    assert b.get_metrics()["kv"]["host_tier"]["host_hit_pages_admit"] == 0
+
+
+# --------------------------------------------------- fleet-level (fake)
+
+VOCAB = 997
+PREFIX = [7, 7, 7, 7]            # one full affinity page (page_size=4)
+
+
+def expected_tokens(prompt, n, vocab=VOCAB):
+    st = 0
+    for t in prompt:
+        st = _chain(st, t)
+    out = []
+    for _ in range(n):
+        nxt = st % vocab
+        st = _chain(st, nxt)
+        out.append(nxt)
+    return out
+
+
+async def start_fabric_fleet(n_workers, model_meta=None, fault_plan=None,
+                             **coord_overrides):
+    """Prefix-affinity fleet of continuous fakes WITH the fake prefix
+    cache on, so kv_export/kv_import carry real (token) payloads."""
+    kw = dict(lb_strategy="prefix_affinity", affinity_page_size=4,
+              affinity_pages=2, retry_seed=7, retry_backoff_base_s=0.01,
+              fabric_snapshot_delay_s=0.0)
+    kw.update(coord_overrides)
+    coord = Coordinator(CoordinatorConfig(**kw))
+    await coord.start()
+    meta = {"continuous": 1, "max_slots": 4, "prefix_cache": 1,
+            "prefix_page_size": 4, "admit_latency_per_token_s": 1e-4}
+    meta.update(model_meta or {})
+    cfg = ModelConfig(name="m", architecture="fake", metadata=meta)
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        if fault_plan is not None:
+            w.fault_plan = fault_plan
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    await coord.deploy_model(cfg, register_shards=False)
+    return coord, workers, cfg
+
+
+async def stop_fleet(coord, workers):
+    await coord.stop()
+    for w in workers.values():
+        try:
+            await w.stop()
+        except Exception:
+            pass
+
+
+def _client(coord, wid):
+    return (coord.router.client_for(wid)
+            if wid in coord.router.workers else coord.lb.client_for(wid))
+
+
+async def test_worker_rpc_export_import_and_reject_counters():
+    """The RPC plane end to end: export off the warm worker, import into
+    the cold one (metrics account bytes both ways), and a tampered wire
+    comes back as a TYPED reject that counts a fallback and admits
+    nothing — the importer's next admission pays normal prefill."""
+    coord, workers, _ = await start_fabric_fleet(2)
+    try:
+        p = PREFIX + [50]
+        r = await coord.submit("m", prompt=p, max_new_tokens=4,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(p, 4)
+        bound = next(iter(coord.lb._affinity.values()))
+        other = next(w for w in workers if w != bound)
+
+        wire = await _client(coord, bound).kv_export("m", p)
+        assert wire is not None and wire["tokens"] == PREFIX
+        res = await _client(coord, other).kv_import("m", wire)
+        assert res["imported_pages"] == 1 and not res.get("rejected")
+
+        bad = dict(wire)
+        bad["tokens"] = [8, 8, 8, 8]             # checksum now stale
+        res = await _client(coord, other).kv_import("m", bad)
+        assert res["imported_pages"] == 0 and res.get("rejected")
+
+        m_bound = await _client(coord, bound).metrics()
+        m_other = await _client(coord, other).metrics()
+        assert m_bound["kv_fabric_exports"] >= 1
+        assert m_bound["kv_fabric_export_bytes"] >= wire_nbytes(wire)
+        assert m_other["kv_fabric_imports"] == 1
+        assert m_other["kv_fabric_import_bytes"] == wire_nbytes(wire)
+        assert m_other["kv_fabric_import_fallbacks"] == 1
+        # the good import made the prefix warm on the importer: traffic
+        # pinned there admits the head for free (fake engine accounting)
+        eng = m_other["models"]["m"]
+        assert eng["fabric_imports"] == 1
+        assert eng["fabric_imported_tokens"] == len(PREFIX)
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_drain_hands_off_bindings_warm():
+    """Graceful drain migrates the retiree's bound prefixes: target
+    imports them BEFORE quarantine, bindings MOVE (handoffs, not
+    rebind-drops), and follow-up traffic rides the warm copy."""
+    coord, workers, _ = await start_fabric_fleet(3)
+    try:
+        for i in range(4):
+            p = PREFIX + [100 + i]
+            r = await coord.submit("m", prompt=p, max_new_tokens=4,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens(p, 4)
+        bound = next(iter(coord.lb._affinity.values()))
+        rebinds0 = coord.lb.get_all_stats()["affinity_rebinds"]
+
+        summary = await coord.drain_worker(bound)
+        hand = summary.get("kv_fabric_handoff")
+        assert hand and hand["bindings_moved"] >= 1
+        assert hand["prefixes_warmed"] >= 1
+        target = hand["target"]
+        assert target != bound
+        lb = coord.lb.get_all_stats()
+        assert lb["affinity_handoffs"] >= 1
+        # moved, NOT dropped: quarantine found no bindings left to count
+        assert lb["affinity_rebinds"] == rebinds0
+        assert set(coord.lb._affinity.values()) == {target}
+
+        for i in range(4, 8):
+            p = PREFIX + [100 + i]
+            r = await coord.submit("m", prompt=p, max_new_tokens=4,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens(p, 4)
+        m = await _client(coord, target).metrics()
+        eng = m["models"]["m"]
+        assert eng["fabric_imports"] >= 1
+        # the handoff import made the prefix warm BEFORE the first
+        # follow-up request: every admission credited the shared head
+        assert eng["prefix_cached_tokens"] >= 4 * len(PREFIX)
+        assert coord.get_stats()["kv_fabric_prewarm_pushes"] >= 1
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_respawn_prewarms_before_half_open():
+    """The supervisor ordering contract: on respawn the coordinator
+    pushes hot prefixes into the worker BEFORE enter_half_open, so the
+    trial probe lands against imported KV."""
+    coord, workers, cfg = await start_fabric_fleet(
+        2, model_meta={"step_latency_s": 0.005},
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1)
+    events = []
+    orig_prewarm = coord.prewarm_worker
+    orig_half_open = coord.lb.enter_half_open
+
+    async def wrapped_prewarm(wid, **kw):
+        got = await orig_prewarm(wid, **kw)
+        events.append(("prewarm", wid, got))
+        return got
+
+    def wrapped_half_open(wid):
+        events.append(("half_open", wid, None))
+        return orig_half_open(wid)
+
+    coord.prewarm_worker = wrapped_prewarm
+    coord.lb.enter_half_open = wrapped_half_open
+    spawned = []
+
+    async def restart_hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+
+    coord.start_supervisor(restart_hook)
+    try:
+        r = await coord.submit("m", prompt=PREFIX + [60], max_new_tokens=4,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(PREFIX + [60], 4)
+        bound = next(iter(coord.lb._affinity.values()))
+
+        prompts = [PREFIX + [61 + i] for i in range(8)]
+        tasks = [asyncio.ensure_future(
+            coord.submit("m", prompt=p, max_new_tokens=6, no_cache=True))
+            for p in prompts]
+        await asyncio.sleep(0.05)
+        await workers.pop(bound).stop()
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert all(isinstance(r, dict)
+                   and r["tokens"] == expected_tokens(p, 6)
+                   for p, r in zip(prompts, results))
+        for _ in range(100):
+            if coord.get_stats()["supervisor_respawns"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert coord.get_stats()["supervisor_respawns"] >= 1
+
+        seq = [(kind, wid) for kind, wid, _ in events]
+        assert ("prewarm", bound) in seq and ("half_open", bound) in seq
+        assert seq.index(("prewarm", bound)) < \
+            seq.index(("half_open", bound)), \
+            f"prewarm must precede half-open: {seq}"
+        # the pre-warm actually landed pages (survivor held the bindings)
+        pushed = next(got for kind, wid, got in events
+                      if (kind, wid) == ("prewarm", bound))
+        assert pushed >= 1
+        assert coord.get_stats()["kv_fabric_prewarm_pushes"] >= 1
+    finally:
+        await stop_fleet(coord, workers)
+        for w in spawned:
+            try:
+                await w.stop()
+            except Exception:
+                pass
+
+
+async def test_stream_failover_imports_cached_wire_token_exact():
+    """Mid-stream kill of the bound worker: the resumed stream is
+    token-exact AND the alternate imported the dead stream's KV pages
+    from the coordinator's snapshot cache (binding handed off, not
+    dropped cold)."""
+    coord, workers, _ = await start_fabric_fleet(
+        2, model_meta={"step_latency_s": 0.01})
+    try:
+        # bind the prefix + let the background snapshot land the wire
+        r = await coord.submit("m", prompt=PREFIX + [41], max_new_tokens=4,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(PREFIX + [41], 4)
+        for _ in range(100):
+            if coord._fabric_cache:
+                break
+            await asyncio.sleep(0.01)
+        assert coord._fabric_cache, "snapshot pull never landed"
+        bound = next(iter(coord.lb._affinity.values()))
+
+        got, killed = [], []
+
+        def on_tokens(toks):
+            got.append(list(toks))
+            if len(got) == 3 and not killed:
+                killed.append(bound)
+                asyncio.ensure_future(workers[bound].stop())
+
+        prompt = PREFIX + [42]
+        r = await coord.submit_stream("m", prompt=prompt,
+                                      max_new_tokens=20,
+                                      on_tokens=on_tokens)
+        exp = expected_tokens(prompt, 20)
+        assert killed and r["tokens"] == exp
+        assert [t for c in got for t in c] == exp
+
+        stats = coord.get_stats()
+        assert stats["kv_fabric_failover_imports"] >= 1
+        assert coord.lb.get_all_stats()["affinity_handoffs"] >= 1
+        survivor = next(w for w in workers if w != bound)
+        assert set(coord.lb._affinity.values()) == {survivor}
+        m = await _client(coord, survivor).metrics()
+        assert m["kv_fabric_imports"] >= 1
+        assert m["models"]["m"]["fabric_imports"] >= 1
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_garbled_import_falls_back_to_prefill():
+    """Chaos thread-through: a garbled kv_import surfaces as a failed
+    (never wrong) push — pre-warm counts failures, nothing is admitted
+    on the target, and traffic stays token-exact via normal prefill."""
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec(kind="garble", rate=1.0, site=SERVER,
+                  verbs=("kv_import",)),
+    ])
+    coord, workers, _ = await start_fabric_fleet(2, fault_plan=plan)
+    try:
+        r = await coord.submit("m", prompt=PREFIX + [70], max_new_tokens=4,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(PREFIX + [70], 4)
+        bound = next(iter(coord.lb._affinity.values()))
+        other = next(w for w in workers if w != bound)
+
+        pushed = await coord.prewarm_worker(other)
+        assert pushed == 0
+        stats = coord.get_stats()
+        assert stats["kv_fabric_prewarm_pushes"] == 0
+        assert stats["kv_fabric_prewarm_failures"] >= 1
+        m = await _client(coord, other).metrics()
+        assert m["models"]["m"]["fabric_imports"] == 0
+
+        # the fleet still serves exactly — cold prefill fallback
+        for i in range(4):
+            p = PREFIX + [71 + i]
+            r = await coord.submit("m", prompt=p, max_new_tokens=4,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens(p, 4)
+        # export stays un-faulted: only the import verb was garbled
+        wire = await _client(coord, bound).kv_export("m", PREFIX + [70])
+        assert wire is not None and wire["tokens"] == PREFIX
+    finally:
+        await stop_fleet(coord, workers)
