@@ -65,6 +65,10 @@ Env knobs:
     BENCH_RUNS     timed repetitions, best-of reported (default 3)
     BENCH_DEFER    1 = defer_sync: overlap each chunk's packed readback
                    with the next chunk's execution (serving-mode lever)
+    BENCH_STREAM   1 = sub-chunk streaming: streaming-flagged slots decode
+                   in BENCH_STREAM_STEPS-step chunks (pow2-bucketed,
+                   default 2) and emit through the device->host token
+                   ring; pure-batch slots keep the full megastep
     BENCH_MIX_EVERY / BENCH_MIX_PROMPT   mixed workload: every Nth serving
                    request carries a BENCH_MIX_PROMPT-token prompt
                    (default 0 = off / 2048)
@@ -98,7 +102,8 @@ Env knobs:
     examples/serving_sweep.py): serving_sweep reads SWEEP_RATES /
     SWEEP_REQUESTS / SWEEP_TRIALS / SWEEP_SHAPE; fleet_sweep reads
     SWEEP_LEGS (comma list to run a subset of
-    replicated,disagg,affinity,kill,autoscale,upgrade,tiny).
+    replicated,disagg,affinity,kill,kvfabric,stream,autoscale,upgrade,
+    tiny).
 """
 
 import json
@@ -259,6 +264,13 @@ def _engine(spec, params, kind: str, batch: int, steps: int):
         # execution (serving-mode lever: the round trip is ~100 ms on a
         # tunnelled chip vs a ~300 ms 16-step chunk)
         cfg.defer_sync = True
+    if os.environ.get("BENCH_STREAM", "") not in ("", "0"):
+        # sub-chunk streaming (ISSUE 13): while any live slot has a
+        # stream callback, clamp decode chunks to BENCH_STREAM_STEPS
+        # (pow2-bucketed) so the token ring emits at sub-chunk cadence;
+        # pure-batch waves keep the full megastep
+        cfg.stream_chunk_steps = int(
+            os.environ.get("BENCH_STREAM_STEPS", "2"))
     if kind == "static":
         from distributed_inference_engine_tpu.engine.engine import Engine
 

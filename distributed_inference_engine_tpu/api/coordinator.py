@@ -64,7 +64,7 @@ from ..serving.cache import ResponseCache
 # request aged out) vs "draining" (the worker is retiring; any other
 # replica can take it). Application errors propagate untouched.
 from ..utils.errors import REASON_DRAINING, TRANSPORT_ERRORS, shed_reason
-from ..utils.tracing import RequestTrace, new_request_id
+from ..utils.tracing import LatencyStats, RequestTrace, new_request_id
 
 logger = logging.getLogger(__name__)
 
@@ -194,6 +194,15 @@ class Coordinator:
         self._overload_rejections = 0   # worker sheds seen (typed error)
         self._dispatch_retries = 0      # re-dispatches (transport/draining)
         self._stream_resumes = 0        # mid-stream failovers with replay
+        # streaming ITL as the CONSUMER sees it (ISSUE 13): inter-frame
+        # gaps measured where submit_stream delivers each frame, i.e.
+        # after engine ring, worker RPC and coordinator relay. Gaps
+        # never span a failover: the timer resets per dispatch attempt.
+        self.stream_itl_stats = LatencyStats()
+        self._stream_frames = 0         # frames relayed to consumers
+        # worker_id -> last observed inter-frame gap (emit lag): a
+        # worker whose gauge grows is buffering frames somewhere
+        self._stream_emit_lag: Dict[str, float] = {}
         self._deadline_expired = 0      # client-visible deadline outcomes
         self._drains = 0                # graceful worker drains completed
         # fleet-level graceful degradation (set_admission_shed): when the
@@ -1066,8 +1075,23 @@ class Coordinator:
         })
         delivered: List[int] = []
         cb = on_tokens or (lambda toks: None)
+        # streaming ITL (ISSUE 13): stamp the gap between consecutive
+        # frames AS DELIVERED to the consumer — after the engine ring,
+        # the worker RPC relay and this coordinator hop. The timer
+        # resets before every dispatch attempt so a failover's detect +
+        # replay delay lands in stream_resumes/the trace, never here.
+        _last_frame = [0.0]
 
         def counting_cb(toks):
+            now = time.perf_counter()
+            if not delivered:
+                trace.mark("first_frame")
+            if _last_frame[0]:
+                gap = now - _last_frame[0]
+                self.stream_itl_stats.add(gap)
+                self._stream_emit_lag[worker_id] = gap
+            _last_frame[0] = now
+            self._stream_frames += 1
             delivered.extend(toks)
             cb(toks)
 
@@ -1104,6 +1128,7 @@ class Coordinator:
                 max_new_tokens=max_new_tokens - prefix,
                 deadline_s=remaining_budget)
             try:
+                _last_frame[0] = 0.0     # new attempt: no cross-attempt gap
                 result = await self._stream_once(model, worker_id, run_req,
                                                  counting_cb)
             except TRANSPORT_ERRORS as e:
@@ -1194,6 +1219,7 @@ class Coordinator:
                             worker_id, alt)
                 try:
                     worker_id = alt
+                    _last_frame[0] = 0.0
                     result = await self._stream_once(model, worker_id,
                                                      run_req, counting_cb)
                 except WorkerRPCError as e2:
@@ -1877,6 +1903,9 @@ class Coordinator:
             "overload_rejections": self._overload_rejections,
             "dispatch_retries": self._dispatch_retries,
             "stream_resumes": self._stream_resumes,
+            "stream_frames": self._stream_frames,
+            "stream_itl": self.stream_itl_stats.snapshot(),
+            "stream_emit_lag": dict(self._stream_emit_lag),
             "deadline_expired": self._deadline_expired,
             "drains": self._drains,
             "admission_sheds": self._admission_sheds,

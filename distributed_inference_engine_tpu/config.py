@@ -176,6 +176,16 @@ class EngineConfig:
                                        # streaming; requires a fully
                                        # backed page pool (num_pages >=
                                        # max_slots * max_pages_per_seq)
+    stream_chunk_steps: int = 0        # sub-chunk streaming (ISSUE 13):
+                                       # while any live slot has a stream
+                                       # callback, clamp decode chunks to
+                                       # this many steps (pow2-bucketed —
+                                       # at most ONE extra decode program)
+                                       # so tokens reach the host ring
+                                       # every few steps instead of once
+                                       # per decode_steps_per_call
+                                       # megastep. Pure-batch rounds keep
+                                       # the full chunk. 0 = off.
     # ---- overload handling (continuous engine; VERDICT r2 item 2) ----
     max_waiting: int = 0               # waiting-queue cap: submit raises a
                                        # typed EngineOverloadedError once

@@ -118,6 +118,52 @@ class _PrefillProgress:
         self.t_submit = t_submit
 
 
+class _ChunkEntry:
+    """One dispatched decode/mixed chunk's packed output in flight to the
+    host, plus everything needed to process it later. Sub-chunk streaming
+    (ISSUE 13) splits chunk processing in two: ``_harvest_chunk`` (the
+    token half — blocking read, token/logprob appends, stop scan, stream
+    emit) and ``_process_packed`` (the control half — pause/finish/revive
+    judgments). ``defer_sync`` dispatches push the entry onto the
+    engine's stream ring and kick an async device→host copy; the pump's
+    ``poll_stream()`` harvests the token half early when the copy lands
+    (inside the measured host bubble), and the deferred flush runs the
+    control half either way — harvest is idempotent via ``harvested``."""
+
+    __slots__ = ("packed", "n_steps", "snapshot", "t0", "caps",
+                 "fresh_firsts", "host", "harvested", "progressed")
+
+    def __init__(self, packed, n_steps: int, snapshot: Dict[int, _Slot],
+                 t0: float, caps: Optional[List[int]],
+                 fresh_firsts: bool) -> None:
+        self.packed = packed
+        self.n_steps = n_steps
+        self.snapshot = snapshot
+        self.t0 = t0
+        self.caps = caps
+        self.fresh_firsts = fresh_firsts
+        self.host: Optional[np.ndarray] = None   # set by _harvest_chunk
+        self.harvested = False
+        # slot -> progressed flag stashed at harvest time, so control can
+        # re-judge without re-deriving it from a possibly-mutated _Slot
+        self.progressed: Dict[int, bool] = {}
+
+    def ready(self) -> bool:
+        """True when the packed buffer can be read without blocking.
+        Backends without ``is_ready`` report NOT ready — the poll must
+        never risk turning the host bubble into a sync point; the
+        deferred flush still reads the buffer (blocking) either way."""
+        if self.host is not None:
+            return True
+        probe = getattr(self.packed, "is_ready", None)
+        if probe is None:
+            return False
+        try:
+            return bool(probe())
+        except Exception:       # pragma: no cover - backend quirk
+            return False
+
+
 class _SwapRecord:
     """A decode sequence preempted to the host tier: its ``_Slot`` state
     plus the exact device KV it held. Invariant carried across the swap:
@@ -258,8 +304,14 @@ class ContinuousEngine:
         self._defer = bool(cfg.defer_sync)
         assert not self._defer or cfg.num_pages >= (
             cfg.max_slots * self.kv.max_pages_per_seq)
-        # (packed device buffer, n_steps, slot snapshot, dispatch t0)
-        self._pending: Optional[Tuple] = None
+        # deferred chunk in flight (see _ChunkEntry); under defer_sync
+        # the same entry also sits on the stream ring below until its
+        # token half is harvested
+        self._pending: Optional[_ChunkEntry] = None
+        # device→host token ring (ISSUE 13): dispatched-but-unharvested
+        # chunks, oldest first. poll_stream() drains ready heads so
+        # streamed tokens reach consumers up to one chunk early.
+        self._ring: Deque[_ChunkEntry] = collections.deque()
         self._ctx_page_buckets = _pow2_buckets(self.kv.max_pages_per_seq)
         self._prefix_hit_admissions = 0
         # chunked prefill: chunk must be page-aligned so every suffix chunk
@@ -818,6 +870,16 @@ class ContinuousEngine:
         # gap between steps. The hook must only enqueue (engine.submit);
         # it must NOT call step()/install paths.
         self.overlap_hook: Optional[Any] = None
+        # sub-chunk streaming counters (ISSUE 13): ring traffic, the
+        # clamp engagements, and firsts-buffer device fetches (the
+        # retire-rescue path's regression guard — one per invalidation,
+        # never per slot)
+        self._ring_pushes = 0        # entries dispatched onto the ring
+        self._ring_polls = 0         # poll_stream calls w/ a live ring
+        self._ring_ready_polls = 0   # polls that harvested an entry
+        self._ring_high_water = 0    # max ring depth observed
+        self._stream_clamped_chunks = 0   # chunks shortened for streaming
+        self._firsts_fetches = 0     # whole-buffer firsts readbacks
 
         if self.artifact_manifest is not None and artifact_selfcheck:
             # golden-token self-check BEFORE any traffic: replays the
@@ -1572,7 +1634,7 @@ class ContinuousEngine:
         if self._pending is not None:
             # selection + capacity below need CURRENT host state
             prev, self._pending = self._pending, None
-            self._process_packed(*prev)
+            self._process_packed(prev)
 
         # --- select prefill rows FIFO under the token budget
         budget = int(getattr(self.config, "mixed_step_tokens", 0) or 0)
@@ -1667,8 +1729,8 @@ class ContinuousEngine:
         # the device is busy with the dispatched step: let the serving
         # layer form the next batch in its shadow (ISSUE 5c)
         self._run_overlap_hook()
-        self._process_packed(packed, 1, dict(self._slots), t0, cap_list,
-                             fresh_firsts=True)
+        self._process_packed(_ChunkEntry(packed, 1, dict(self._slots), t0,
+                                         cap_list, True))
 
         # --- prefill bookkeeping, mirroring _advance_group: only the LAST
         # chunk's sample is the real first token
@@ -1699,14 +1761,15 @@ class ContinuousEngine:
 
     # ---------------------------------------------------------- streaming
 
-    def _emit_stream(self, state: _Slot) -> None:
+    def _emit_stream(self, state: _Slot) -> int:
         """Push newly generated tokens to the slot's streaming callback,
         trimmed exactly like ``_finish`` trims the final result (cap at
         max_new_tokens, cut after EOS) so a streaming consumer never sees
-        tokens the result won't contain."""
+        tokens the result won't contain. Returns 1 when a frame was
+        delivered (ring poll accounting), else 0."""
         cb = state.on_tokens
         if cb is None:
-            return
+            return 0
         req = state.request
         toks = state.tokens[: req.max_new_tokens]
         if 0 <= state.stop_cut <= len(toks):
@@ -1722,6 +1785,8 @@ class ContinuousEngine:
                 logger.exception("stream callback failed for %s",
                                  req.request_id)
                 state.on_tokens = None     # don't retry a broken consumer
+            return 1
+        return 0
 
     # ------------------------------------------------------------- finish
 
@@ -1735,7 +1800,26 @@ class ContinuousEngine:
         if self._firsts_host is None:
             # graftlint: ok[host-sync-hot-path] cache-miss refill: ONE whole-buffer read replaces a per-slot round trip (see docstring)
             self._firsts_host = np.asarray(self._firsts_dev)
+            self._firsts_fetches += 1   # regression guard: per
+            #                             invalidation, never per slot
         return self._firsts_host
+
+    def _rescue_first(self, state: _Slot, slot: int) -> None:
+        """Deliver a deferred first token for a slot retiring before any
+        packed read harvested it. Reads the BATCHED firsts snapshot —
+        cached in ``_firsts_host``, so a whole retire wave shares one
+        device fetch at most (``firsts_fetches`` counts them; ISSUE 13
+        replaces the old per-slot ``ascontiguousarray`` recompute with
+        direct column indexing)."""
+        state.first_pending = False
+        fp = self._firsts_snapshot()
+        state.tokens.insert(0, int(fp[0, slot]))
+        # 1-element copy: the column slice is strided, .view needs
+        # contiguous bytes — but only 4 of them, not the whole column
+        state.logprobs.insert(
+            0, float(fp[1:2, slot].copy().view(np.float32)[0]))
+        state.first_token_at = time.perf_counter()
+        self.ttft_stats.add(state.first_token_at - state.admitted_at)
 
     def _finish(self, slot: int, reason: str) -> None:
         state = self._slots.pop(slot)
@@ -1746,12 +1830,7 @@ class ContinuousEngine:
             # retired before any packed read delivered its deferred first
             # token (e.g. capacity-retire on the very next step): rescue
             # it from the batched snapshot — no per-slot round trip
-            state.first_pending = False
-            fp = np.ascontiguousarray(self._firsts_snapshot()[:, slot])
-            state.tokens.insert(0, int(fp[0]))
-            state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
-            state.first_token_at = time.perf_counter()
-            self.ttft_stats.add(state.first_token_at - state.admitted_at)
+            self._rescue_first(state, slot)
         toks, stopped = trim_at_stops(state.tokens, req)
         if stopped:
             reason = "stop"
@@ -1785,12 +1864,7 @@ class ContinuousEngine:
             # the deferred first token lives only in the device firsts
             # buffer, which the slot's successor will overwrite — rescue
             # it now (same batched snapshot as _finish)
-            state.first_pending = False
-            fp = np.ascontiguousarray(self._firsts_snapshot()[:, slot])
-            state.tokens.insert(0, int(fp[0]))
-            state.logprobs.insert(0, float(fp[1:].view(np.float32)[0]))
-            state.first_token_at = time.perf_counter()
-            self.ttft_stats.add(state.first_token_at - state.admitted_at)
+            self._rescue_first(state, slot)
             state.produced = len(state.tokens)
             state.stop_cut = find_stop_cut(state.tokens, req)
         if state.produced >= req.max_new_tokens or state.stop_cut >= 0:
@@ -2007,6 +2081,7 @@ class ContinuousEngine:
             # buffer and _Slot references here instead of holding them
             # across an idle period
             self._pending = None
+            self._ring.clear()
             return len(self._prefilling) + len(self._swapped)
         self._steps += 1
         self._occupancy_sum += len(self._slots)   # batch occupancy metric
@@ -2034,7 +2109,7 @@ class ContinuousEngine:
                 # grants already covered the in-flight chunk (ahead =
                 # 2*n_steps), so flushing mid-loop is safe for them.
                 prev, self._pending = self._pending, None
-                self._process_packed(*prev)
+                self._process_packed(prev)
                 if self._slots.get(slot) is not state:
                     continue             # the flush finished this slot
                 cur = int(lengths_np[slot])
@@ -2049,6 +2124,21 @@ class ContinuousEngine:
             else:
                 n_steps = min(n_steps, cap_tok - cur)
         self._deactivate_many(retired)
+
+        # adaptive chunk length (ISSUE 13): while ANY live slot is
+        # streaming, decode in shorter chunks so tokens reach the host
+        # (and the ring poll) every stream_chunk_steps instead of every
+        # full megastep. Pow2-bucketed so the whole run adds at most ONE
+        # decode program per (bucket, ctx) pair — the compile-count guard
+        # in tests/test_streaming.py audits this. Pure-batch rounds keep
+        # the full chunk: the clamp looks at live callbacks, not config.
+        scs = int(getattr(self.config, "stream_chunk_steps", 0) or 0)
+        if scs > 0 and n_steps > 1 and any(
+                s.on_tokens is not None for s in self._slots.values()):
+            sub = 1 << (scs - 1).bit_length()
+            if sub < n_steps:
+                n_steps = sub
+                self._stream_clamped_chunks += 1
 
         if not self._slots or n_steps <= 0:
             return (len(self._slots) + len(self._prefilling)
@@ -2097,43 +2187,84 @@ class ContinuousEngine:
         # processed must not have the old chunk's column applied to it
         snapshot = dict(self._slots)
         if self._defer:
-            prev, self._pending = self._pending, (packed, n_steps,
-                                                  snapshot, t0, cap_list)
+            entry = _ChunkEntry(packed, n_steps, snapshot, t0, cap_list,
+                                False)
+            # ring push + async device→host copy: by the time the pump
+            # polls (overlap hook / between steps) the bytes are usually
+            # already host-side and the harvest costs no sync
+            self._ring.append(entry)
+            self._ring_pushes += 1
+            if len(self._ring) > self._ring_high_water:
+                self._ring_high_water = len(self._ring)
+            start = getattr(packed, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:   # pragma: no cover - backend quirk
+                    pass
+            prev, self._pending = self._pending, entry
             if prev is not None:
-                self._process_packed(*prev)
+                self._process_packed(prev)
         else:
-            self._process_packed(packed, n_steps, snapshot, t0, cap_list,
-                                 fresh_firsts=True)
+            self._process_packed(_ChunkEntry(packed, n_steps, snapshot,
+                                             t0, cap_list, True))
         self._tl_record("decode", t0, program=("decode", n_steps, mpb),
                         rows=len(snapshot), n_steps=n_steps)
         return (len(self._slots) + len(self._prefilling)
                 + len(self._swapped))
 
-    def _process_packed(self, packed, n_steps: int,
-                        snapshot: Dict[int, _Slot], t0: float,
-                        caps: Optional[List[int]] = None,
-                        fresh_firsts: bool = False) -> None:
-        """Host bookkeeping of one decode chunk's packed output: append
-        tokens, update the length mirror, detect host-side stops, stream,
-        finish retired slots. ``snapshot`` is the slot map at dispatch —
-        entries whose ``_Slot`` is no longer current are skipped.
-        ``caps`` is the per-slot token-capacity array the chunk was
-        dispatched with — needed to tell a PAUSED slot (device stopped at
-        the chunk's capacity grant) from a finished one. ``fresh_firsts``
-        marks SYNC call sites, where no install can have landed between
-        dispatch and this read — the packed firsts rows are then current
-        and refresh the host cache for free (deferred processing runs a
-        chunk behind admissions, so its rows may be stale)."""
+    def poll_stream(self) -> int:
+        """Drain ready stream-ring entries' TOKEN halves without blocking
+        (ISSUE 13). The serving pump calls this inside the measured host
+        bubble — the overlap hook right after dispatch and the gap
+        between steps — so streamed tokens reach consumers as soon as
+        the async copy lands instead of one full chunk later at the
+        deferred flush. Control (pause/finish/revive) stays with the
+        flush: ``_harvest_chunk`` is idempotent, so the later
+        ``_process_packed`` call skips straight to judging. Returns the
+        number of streamed frames delivered."""
+        if not self._ring:
+            return 0
+        self._ring_polls += 1
+        frames = 0
+        while self._ring:
+            entry = self._ring[0]
+            if entry.harvested:
+                self._ring.popleft()
+                continue
+            if not entry.ready():
+                break
+            self._ring_ready_polls += 1
+            frames += self._harvest_chunk(entry)
+        return frames
+
+    def _harvest_chunk(self, entry: _ChunkEntry) -> int:
+        """TOKEN half of chunk processing: the blocking host read (a
+        no-op wait when the ring's async copy already landed), token and
+        logprob appends, the length-mirror refresh, the incremental stop
+        scan, and the streaming emit. Idempotent — guarded by
+        ``entry.harvested`` — so the ring poll and the deferred flush
+        compose. Snapshot-identity rules match ``_process_packed``:
+        columns apply only to the exact ``_Slot`` objects live at
+        dispatch. Returns streamed frames delivered."""
+        if entry.harvested:
+            return 0
+        entry.harvested = True
+        try:                      # pop self from the ring, wherever it is
+            self._ring.remove(entry)
+        except ValueError:
+            pass
+        n_steps = entry.n_steps
         t_read = time.perf_counter()
         # graftlint: ok[host-sync-hot-path] THE designed sync point: ONE packed read per decode chunk carries tokens+lps+active+lengths+firsts
-        packed_np = np.asarray(packed)   # ONE blocking read per chunk
+        packed_np = np.asarray(entry.packed)   # ONE blocking read per chunk
+        entry.host = packed_np
         toks_np = packed_np[:n_steps]                    # [n_steps, max_slots]
         lps_np = packed_np[n_steps:2 * n_steps].view(np.float32)
-        active_np = packed_np[2 * n_steps].astype(bool)
         lengths_row = packed_np[2 * n_steps + 1].astype(np.int32)
         firsts_tok = packed_np[2 * n_steps + 2]          # deferred admissions
         firsts_lp = packed_np[2 * n_steps + 3].view(np.float32)
-        if fresh_firsts:
+        if entry.fresh_firsts:
             # the whole firsts buffer rode the packed read: retire-path
             # rescues (_finish/_try_swap_out) read this copy instead of
             # paying a per-slot device round trip (ISSUE 5 satellite)
@@ -2143,11 +2274,10 @@ class ContinuousEngine:
         # clock), so record the actual blocking WAIT — the residue the
         # overlap failed to hide; near zero means the overlap is working
         self.chunk_stats.add(time.perf_counter()
-                             - (t_read if self._defer else t0))
+                             - (t_read if self._defer else entry.t0))
 
-        stop_retired: List[int] = []
-        revived: List[int] = []
-        for slot, state in snapshot.items():
+        frames = 0
+        for slot, state in entry.snapshot.items():
             if self._slots.get(slot) is not state:
                 continue                 # finished earlier (or slot reused)
             self._lengths_host[slot] = lengths_row[slot]
@@ -2160,8 +2290,10 @@ class ContinuousEngine:
             # slot's revive lands after the next chunk already launched —
             # that chunk's harvest must not re-judge the slot (its caps
             # row is from AFTER the pool grew, so the pause test would
-            # misread the pause as a finished "length").
-            progressed = bool(state.first_pending or (col >= 0).any())
+            # misread the pause as a finished "length"). Stashed on the
+            # entry: control may run after further slot mutation.
+            entry.progressed[slot] = bool(state.first_pending
+                                          or (col >= 0).any())
             prev = len(state.tokens)           # first index not yet stop-checked
             if state.first_pending:
                 # harvest the deferred first token (prev stays 0: the stop
@@ -2184,7 +2316,36 @@ class ContinuousEngine:
                 # scan only the new window: O(total) stop detection across
                 # a generation, shared with the streaming emit below
                 state.stop_cut = find_stop_cut(state.tokens, req, start=prev)
-            self._emit_stream(state)
+            frames += self._emit_stream(state)
+        return frames
+
+    def _process_packed(self, entry: _ChunkEntry) -> None:
+        """CONTROL half of chunk processing: finish retired slots, retire
+        host-side stops, revive capacity-paused slots. Harvests the token
+        half first when the ring poll has not already done so (the
+        common non-streaming case — one call does both halves, exactly
+        the pre-ring behavior). ``entry.caps`` is the per-slot
+        token-capacity array the chunk was dispatched with — needed to
+        tell a PAUSED slot (device stopped at the chunk's capacity
+        grant) from a finished one. ``entry.fresh_firsts`` marks SYNC
+        call sites, where no install can have landed between dispatch
+        and the read — the packed firsts rows are then current and
+        refresh the host cache for free (deferred processing runs a
+        chunk behind admissions, so its rows may be stale)."""
+        self._harvest_chunk(entry)
+        packed_np = entry.host
+        n_steps = entry.n_steps
+        caps = entry.caps
+        active_np = packed_np[2 * n_steps].astype(bool)
+        lengths_row = packed_np[2 * n_steps + 1].astype(np.int32)
+
+        stop_retired: List[int] = []
+        revived: List[int] = []
+        for slot, state in entry.snapshot.items():
+            if self._slots.get(slot) is not state:
+                continue                 # finished earlier (or slot reused)
+            progressed = entry.progressed.get(slot, False)
+            req = state.request
             if not active_np[slot]:
                 if not progressed:
                     # inactive for the WHOLE chunk: pause/finish was (or
@@ -2282,6 +2443,7 @@ class ContinuousEngine:
              + len(self._slots) + len(self._prefilling)
              + len(self._swapped))
         self._pending = None            # drop an unprocessed deferred chunk
+        self._ring.clear()              # and its stream-ring entry
         self._waiting.clear()
         self._waiting_prefilled.clear()
         while self._swapped:            # release their host reservations
@@ -2425,6 +2587,15 @@ class ContinuousEngine:
             "host_bubble_frac": (
                 self._host_gap_s / (self._dispatch_s + self._host_gap_s)
                 if (self._dispatch_s + self._host_gap_s) > 0 else 0.0),
+            # sub-chunk streaming (ISSUE 13): ring traffic + adaptive
+            # chunk engagements, and the firsts-buffer fetch count the
+            # retire-rescue regression test pins (one per invalidation)
+            "stream_ring_pushes": self._ring_pushes,
+            "stream_ring_polls": self._ring_polls,
+            "stream_ring_ready_polls": self._ring_ready_polls,
+            "stream_ring_depth": self._ring_high_water,
+            "stream_clamped_chunks": self._stream_clamped_chunks,
+            "firsts_fetches": self._firsts_fetches,
             "ttft": self.ttft_stats.snapshot(),
             "batch_occupancy": (self._occupancy_sum
                                 / (self._steps * self.max_slots)

@@ -97,6 +97,10 @@ def engine_from_config(cfg):
                 prefix_cache=bool(cfg.metadata.get("prefix_cache", False)),
                 prefix_page_size=int(
                     cfg.metadata.get("prefix_page_size", 64)),
+                stream_chunk_tokens=int(
+                    cfg.metadata.get("stream_chunk_tokens", 0)),
+                stream_dispatch_overhead_s=float(
+                    cfg.metadata.get("stream_dispatch_overhead_s", 0.0)),
             )
         return FakeEngine(
             latency_s=float(cfg.metadata.get("latency_s", 0.0)),
@@ -154,7 +158,8 @@ def engine_from_config(cfg):
               "attention_impl", "kv_dtype", "prefill_buckets",
               "prefix_cache", "prefill_chunk", "decode_mode",
               "max_waiting", "queue_deadline_s",
-              "kv_offload", "kv_offload_bytes", "mixed_step_tokens"):
+              "kv_offload", "kv_offload_bytes", "mixed_step_tokens",
+              "stream_chunk_steps"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

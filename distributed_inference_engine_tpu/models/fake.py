@@ -131,12 +131,25 @@ class FakeContinuousEngine:
                  queue_deadline_s: float = 0.0, vocab_size: int = 997,
                  admit_latency_per_token_s: float = 0.0,
                  prefix_cache: bool = False,
-                 prefix_page_size: int = 64) -> None:
+                 prefix_page_size: int = 64,
+                 stream_chunk_tokens: int = 0,
+                 stream_dispatch_overhead_s: float = 0.0) -> None:
         self.config = FakeEngineConfig(
             max_waiting=int(max_waiting),
             queue_deadline_s=float(queue_deadline_s))
         self.step_latency_s = float(step_latency_s)
         self.tokens_per_step = max(1, int(tokens_per_step))
+        # sub-chunk streaming model (ISSUE 13), mirroring the real
+        # engine's EngineConfig.stream_chunk_steps: while any live slot
+        # has a callback, the step's wall time splits into
+        # ceil(tokens_per_step / stream_chunk_tokens) sub-chunks and
+        # callbacks fire per sub-chunk — ITL collapses from one frame
+        # per step to one per sub-chunk. Each EXTRA sub-dispatch costs
+        # stream_dispatch_overhead_s (the shorter-chunk goodput tax the
+        # stream leg measures). 0 = off: byte-identical to the old step.
+        self.stream_chunk_tokens = max(0, int(stream_chunk_tokens))
+        self.stream_dispatch_overhead_s = float(stream_dispatch_overhead_s)
+        self._stream_sub_chunks = 0
         self.max_slots = max(1, int(max_slots))
         self.vocab_size = max(2, int(vocab_size))
         # prefix-cache TTFT model: admission costs
@@ -348,46 +361,70 @@ class FakeContinuousEngine:
             self._live.append([req, cb, t, state, toks])
         if not self._live:
             return 0
+        # sub-chunk split (ISSUE 13): engages only while a live slot is
+        # actually streaming, like the real engine's adaptive clamp —
+        # pure-batch traffic keeps the single full-step dispatch
+        sizes = [self.tokens_per_step]
+        if (self.stream_chunk_tokens
+                and self.stream_chunk_tokens < self.tokens_per_step
+                and any(s[1] is not None for s in self._live)):
+            k = self.stream_chunk_tokens
+            sizes = [k] * (self.tokens_per_step // k)
+            if self.tokens_per_step % k:
+                sizes.append(self.tokens_per_step % k)
+        sub_sleep = self.step_latency_s / len(sizes)
         t_step = time.perf_counter()
-        if self.step_latency_s:
-            time.sleep(self.step_latency_s)
         self._steps += 1
-        now = time.perf_counter()
+        had = {id(s): bool(s[4]) for s in self._live}
+        done_slots: set = set()
+        now = t_step
+        for si, budget in enumerate(sizes):
+            if si and self.stream_dispatch_overhead_s:
+                # each extra sub-dispatch pays one more host round trip
+                time.sleep(self.stream_dispatch_overhead_s)
+            if sub_sleep:
+                time.sleep(sub_sleep)
+            now = time.perf_counter()
+            if len(sizes) > 1:
+                self._stream_sub_chunks += 1
+            for slot in self._live:
+                key = id(slot)
+                if key in done_slots:
+                    continue
+                req, cb, t, state, toks = slot
+                fresh: List[int] = []
+                done = False
+                for _ in range(budget):
+                    nxt = state % self.vocab_size
+                    state = _chain(state, nxt)
+                    toks.append(nxt)
+                    fresh.append(nxt)
+                    self._total_generated += 1
+                    if nxt == req.eos_id or nxt in (req.stop_ids or ()):
+                        done = True
+                        break
+                    if len(toks) >= req.max_new_tokens:
+                        done = True
+                        break
+                slot[3] = state
+                if fresh and cb is not None:
+                    cb(list(fresh))
+                if fresh and not had[key]:
+                    had[key] = True
+                    self.ttft_stats.add(now - t)
+                if done:
+                    done_slots.add(key)
+                    stopped = bool(toks) and (
+                        toks[-1] == req.eos_id
+                        or toks[-1] in (req.stop_ids or ()))
+                    self._finished.append(GenerationResult(
+                        request_id=req.request_id, tokens=list(toks),
+                        finish_reason="stop" if stopped else "length",
+                        prompt_tokens=len(req.prompt), ttft_s=now - t,
+                        decode_s=now - t, metadata={"fake": True}))
         self.step_stats.add(now - t_step)
-        still: List[list] = []
-        for slot in self._live:
-            req, cb, t, state, toks = slot
-            had_tokens = bool(toks)
-            fresh: List[int] = []
-            done = False
-            for _ in range(self.tokens_per_step):
-                nxt = state % self.vocab_size
-                state = _chain(state, nxt)
-                toks.append(nxt)
-                fresh.append(nxt)
-                self._total_generated += 1
-                if nxt == req.eos_id or nxt in (req.stop_ids or ()):
-                    done = True
-                    break
-                if len(toks) >= req.max_new_tokens:
-                    done = True
-                    break
-            slot[3] = state
-            if fresh and cb is not None:
-                cb(list(fresh))
-            if fresh and not had_tokens:
-                self.ttft_stats.add(now - t)
-            if done:
-                stopped = bool(toks) and (
-                    toks[-1] == req.eos_id or toks[-1] in (req.stop_ids or ()))
-                self._finished.append(GenerationResult(
-                    request_id=req.request_id, tokens=list(toks),
-                    finish_reason="stop" if stopped else "length",
-                    prompt_tokens=len(req.prompt), ttft_s=now - t,
-                    decode_s=now - t, metadata={"fake": True}))
-            else:
-                still.append(slot)
-        self._live = still
+        if done_slots:
+            self._live = [s for s in self._live if id(s) not in done_slots]
         return len(self._live)
 
     def generate(self, requests: List[GenerationRequest]) -> List[GenerationResult]:
@@ -443,6 +480,7 @@ class FakeContinuousEngine:
             "fabric_exports": self._fabric_exports,
             "fabric_imports": self._fabric_imports,
             "fabric_imported_tokens": self._fabric_imported_tokens,
+            "stream_sub_chunks": self._stream_sub_chunks,
             "ttft": self.ttft_stats.snapshot(),
             "decode_chunk": self.step_stats.snapshot(),
             "spec": {"fake": True, "continuous": True},

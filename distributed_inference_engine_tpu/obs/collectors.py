@@ -87,6 +87,18 @@ ENGINE_TABLE = [
      "Accepted / proposed draft tokens"),
     ("tokens_per_round", "engine_spec_tokens_per_round", "g",
      "Mean tokens emitted per speculative round"),
+    ("stream_ring_pushes", "engine_stream_ring_pushes", "c",
+     "Decode chunks pushed onto the device->host token ring"),
+    ("stream_ring_polls", "engine_stream_ring_polls", "c",
+     "poll_stream calls that found ring entries in flight"),
+    ("stream_ring_ready_polls", "engine_stream_ring_ready_polls", "c",
+     "Ring entries harvested early by a host-bubble poll"),
+    ("stream_ring_depth", "engine_stream_ring_depth", "g",
+     "High-water depth of the device->host token ring"),
+    ("stream_clamped_chunks", "engine_stream_clamped_chunks", "c",
+     "Decode chunks shortened by the adaptive streaming clamp"),
+    ("firsts_fetches", "engine_firsts_fetches", "c",
+     "Whole-buffer deferred-firsts readbacks (one per invalidation)"),
     ("ttft", "engine_ttft_seconds", "h",
      "Time to first token (continuous: from submit, incl. queue wait)"),
     ("prefill", "engine_prefill_seconds", "h", "Prefill dispatch wall time"),
@@ -269,6 +281,10 @@ COORDINATOR_TABLE = [              # Coordinator.get_stats() top level
      "Re-dispatches after transport failures or draining sheds"),
     ("stream_resumes", "coordinator_stream_resumes", "c",
      "Streams resumed on an alternate worker via prefix replay"),
+    ("stream_frames", "coordinator_stream_frames", "c",
+     "Streamed token frames relayed to consumers"),
+    ("stream_itl", "coordinator_stream_itl_seconds", "h",
+     "Inter-frame gap at stream delivery (resets across failover)"),
     ("deadline_expired", "coordinator_deadline_expired", "c",
      "Requests answered with the typed deadline outcome"),
     ("drains", "coordinator_drains", "c",
@@ -360,6 +376,8 @@ EXTRA_FAMILIES = [
      "Worker process resident set size (psutil, 0 if unavailable)"),
     ("fleet_worker_role", "g", ("worker_id", "role"),
      "1 for the worker's fleet role: prefill / decode / replica"),
+    ("coordinator_stream_emit_lag_seconds", "g", ("worker_id",),
+     "Last inter-frame gap observed per worker on streamed frames"),
     ("autoscaler_decisions", "c", ("action",),
      "Scaling decisions by action: up / down / shed_on / shed_off"),
 ]
@@ -539,6 +557,13 @@ def apply_coordinator(reg: MetricsRegistry,
                         ("worker_id", "role"))
         for wid, role in roles.items():
             fam.labels(worker_id=str(wid), role=str(role)).set(1.0)
+    lag = cs.get("stream_emit_lag")
+    if isinstance(lag, Mapping):
+        fam = reg.gauge("coordinator_stream_emit_lag_seconds",
+                        CATALOG["coordinator_stream_emit_lag_seconds"][2],
+                        ("worker_id",))
+        for wid, gap in lag.items():
+            fam.labels(worker_id=str(wid)).set(float(gap))
 
 
 def apply_autoscaler(reg: MetricsRegistry,

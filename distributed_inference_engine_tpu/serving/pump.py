@@ -57,6 +57,11 @@ class EnginePump:
             # the engine config — only the engine's _step_mixed reads it.
             engine.config.mixed_step_tokens = int(mixed_step_tokens)
         self._overlap_admitted = 0
+        self._stream_frames_polled = 0
+        # sub-chunk streaming (ISSUE 13): harvest ready token-ring
+        # entries inside the measured host bubble. Engine-thread-only by
+        # the same argument as the overlap hook below.
+        self._poll_stream = getattr(engine, "poll_stream", None)
         if overlap_forms and hasattr(engine, "overlap_hook"):
             # batch-formation overlap (ISSUE 5c): the engine calls this
             # right after dispatching a decode/mixed chunk, while the
@@ -68,6 +73,11 @@ class EnginePump:
             # engine via submit()/submit_prefilled() (enqueue-only).
             def _overlap() -> None:
                 self._overlap_admitted += self._drain_inbox()
+                # the previous chunk's async device→host copy has had a
+                # full chunk of device time to land: drain it now so
+                # streaming consumers see its tokens one chunk early
+                if self._poll_stream is not None:
+                    self._stream_frames_polled += self._poll_stream()
 
             engine.overlap_hook = _overlap
         # (request, optional handoff, optional stream cb, future, loop)
@@ -204,6 +214,10 @@ class EnginePump:
                     live = self.engine.step()
                     for res in self.engine.drain_finished():
                         self._resolve(res)
+                    # between-steps half of the host bubble: the chunk
+                    # dispatched by step() may already be host-side
+                    if self._poll_stream is not None:
+                        self._stream_frames_polled += self._poll_stream()
             except Exception as e:  # engine failure fans to all in-flight
                 self._step_errors += 1
                 logger.exception("engine pump step failed")
@@ -301,5 +315,8 @@ class EnginePump:
             # requests admitted INSIDE a device step's shadow via the
             # engine's overlap hook (vs the top-of-loop drain)
             "overlap_admitted": self._overlap_admitted,
+            # streamed frames delivered by host-bubble ring polls rather
+            # than the deferred flush (ISSUE 13)
+            "stream_frames_polled": self._stream_frames_polled,
             "engine": self.engine.get_metrics(),
         }

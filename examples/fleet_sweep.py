@@ -36,6 +36,16 @@ after a failover — must produce the same stream):
               alternate and hands the binding over. Acceptance: 100%
               token-exact, resumed TTFT ≤ 2x the affinity-hit TTFT, and
               two same-seed runs produce identical token receipts.
+  stream      sub-chunk streaming at the SLO knee (ISSUE 13): N=2 replicas
+              driven through ``Coordinator.submit_stream`` at ~50% of
+              fleet capacity, once with whole-chunk emission (the fake's
+              8-token megastep: ITL is chunk-quantized at 8x the per-step
+              decode time) and once with 1-token sub-chunks through the
+              device->host token ring. Acceptance: streaming ITL p99 <=
+              1.5x per-step decode time, goodput within 10% of the
+              non-streaming run, every stream token-exact (streamed concat
+              == final result == crc chain), and two same-seed streaming
+              runs produce identical token receipts.
   autoscale   the SLO loop closed (cluster/autoscaler.py): fleet starts at
               BENCH_FLEET_MIN under easy load, offered load jumps to
               BENCH_FLEET_BURST× one worker's capacity mid-run — the
@@ -885,16 +895,138 @@ async def leg_kvfabric():
     return rows
 
 
+async def _stream_run(meta, n, prompts, rate, nt, seed):
+    """One seeded streaming pass: every request rides submit_stream, each
+    delivered frame is stamped at the coordinator hand-off (the consumer
+    side of the relay — engine ring, worker RPC and coordinator hop are
+    all inside the gap). Returns per-token ITLs built the serving_main
+    way: one inter-frame gap per frame, zero-cost co-arrivals for the
+    rest of the frame's tokens."""
+    coord, workers = await start_fleet(n)
+    await coord.deploy_model(fake_cfg(**meta), register_shards=False)
+    rs = np.random.RandomState(seed)
+    marks = [[] for _ in prompts]
+
+    def mk_cb(rec):
+        def cb(toks):
+            rec.append((time.perf_counter(), list(toks)))
+        return cb
+
+    tasks = []
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        tasks.append(asyncio.ensure_future(coord.submit_stream(
+            "m", prompt=p, max_new_tokens=nt, on_tokens=mk_cb(marks[i]),
+            request_id=f"s{i}")))
+        await asyncio.sleep(float(rs.exponential(1.0 / rate)))
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    wall = time.perf_counter() - t0
+    itls, frames, spliced = [], 0, 0
+    for ms, r in zip(marks, results):
+        frames += len(ms)
+        streamed = [t for _, toks in ms for t in toks]
+        if isinstance(r, dict) and streamed == r.get("tokens"):
+            spliced += 1
+        prev = None
+        for t, toks in ms:
+            if prev is not None:
+                itls.append(t - prev)
+            itls.extend([0.0] * (len(toks) - 1))
+            prev = t
+    st = coord.get_stats()
+    receipt = [tuple(r["tokens"]) if isinstance(r, dict) else ("ERR",)
+               for r in results]
+    await stop_fleet(coord, workers)
+    return results, wall, itls, frames, spliced, st, receipt
+
+
+async def leg_stream():
+    """Sub-chunk streaming vs whole-chunk emission at the SLO knee
+    (ISSUE 13's measurement half). Calibration: 8 tokens per 80 ms fake
+    step = 10 ms per-step decode time, so whole-chunk ITL is quantized at
+    8x (one 8-token frame per step) while 1-token sub-chunks should land
+    each token within 1.5x."""
+    n = 2
+    nt = bench.FLEET_NEW_TOKENS
+    tps, step_s = 8, 0.08
+    per_token = step_s / tps              # the per-step decode analog
+    base_meta = dict(step_latency_s=step_s, tokens_per_step=tps)
+    sub_meta = dict(base_meta, stream_chunk_tokens=1,
+                    stream_dispatch_overhead_s=1e-4)
+    # the knee: ~50% of fleet token capacity — past it queueing noise
+    # drowns the emission cadence this leg is isolating
+    cap = bench.FLEET_SLOTS * tps / step_s / nt   # req/s per worker
+    rate = 0.5 * cap * n
+    n_req = bench.FLEET_REQUESTS * n
+    prompts = prompts_unique(n_req, bench.FLEET_SEED + 401)
+    rows, receipts = [], {}
+    runs = (("base", base_meta), ("sub", sub_meta), ("sub_replay", sub_meta))
+    for mode, meta in runs:
+        results, wall, itls, frames, spliced, st, receipt = \
+            await _stream_run(meta, n, prompts, rate, nt,
+                              bench.FLEET_SEED + 401)
+        receipts[mode] = receipt
+        ok, toks = score(prompts, results, nt)
+        itl_stats = st.get("stream_itl", {})
+        row = {
+            "leg": f"stream_{mode}", "workers": n, "requests": n_req,
+            "offered_req_s": round(rate, 1),
+            "goodput_toks": round(toks / wall, 1),
+            "token_exact": ok,
+            "token_exact_frac": round(ok / max(1, n_req), 4),
+            "stream_spliced_exact": spliced,
+            "frames": frames,
+            "frames_per_req": round(frames / max(1, n_req), 2),
+            "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
+            "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
+            "per_step_ms": round(per_token * 1e3, 2),
+            "coord_stream_frames": st.get("stream_frames", 0),
+            "coord_itl_count": int(itl_stats.get("count", 0)),
+            "wall_s": round(wall, 2),
+        }
+        rows.append(emit(row))
+        assert ok == n_req, f"stream_{mode}: {ok}/{n_req} token-exact"
+        assert spliced == n_req, \
+            f"stream_{mode}: {spliced}/{n_req} streams spliced exact"
+    base, sub = rows[0], rows[1]
+    itl_ratio = sub["itl_p99_ms"] / (per_token * 1e3)
+    base_ratio = base["itl_p99_ms"] / (per_token * 1e3)
+    goodput_frac = sub["goodput_toks"] / max(base["goodput_toks"], 1e-9)
+    replay_ok = receipts["sub"] == receipts["sub_replay"]
+    log(f"  stream: ITL p99 {base['itl_p99_ms']:.2f} ms "
+        f"({base_ratio:.1f}x per-step, chunk-quantized) -> "
+        f"{sub['itl_p99_ms']:.2f} ms ({itl_ratio:.2f}x per-step, "
+        f"acceptance <= 1.5x); goodput {base['goodput_toks']} -> "
+        f"{sub['goodput_toks']} tok/s ({goodput_frac:.1%}, acceptance "
+        f">= 90%); same-seed receipts "
+        f"{'IDENTICAL' if replay_ok else 'DIVERGED'}")
+    assert base_ratio >= 0.95 * tps, \
+        f"baseline ITL p99 {base_ratio:.2f}x not chunk-quantized"
+    assert itl_ratio <= 1.5, \
+        f"streaming ITL p99 {itl_ratio:.2f}x per-step (acceptance <= 1.5x)"
+    assert goodput_frac >= 0.9, \
+        f"streaming goodput {goodput_frac:.1%} of baseline (floor 90%)"
+    assert replay_ok, "same-seed streaming runs diverged"
+    rows.append(emit({"leg": "stream", "summary": True,
+                      "itl_p99_over_per_step": round(itl_ratio, 2),
+                      "baseline_itl_p99_over_per_step": round(base_ratio, 2),
+                      "goodput_vs_base": round(goodput_frac, 4),
+                      "receipts_identical": replay_ok}))
+    dump_leg("stream", rows)
+    return rows
+
+
 LEGS = {"replicated": leg_replicated, "disagg": leg_disagg,
         "affinity": leg_affinity, "kill": leg_kill,
-        "kvfabric": leg_kvfabric,
+        "kvfabric": leg_kvfabric, "stream": leg_stream,
         "autoscale": leg_autoscale, "upgrade": leg_upgrade}
 
 
 async def main_async():
     want = [s for s in os.environ.get(
         "SWEEP_LEGS",
-        "replicated,disagg,affinity,kill,kvfabric,autoscale,upgrade,tiny"
+        "replicated,disagg,affinity,kill,kvfabric,stream,autoscale,"
+        "upgrade,tiny"
     ).split(",") if s]
     all_rows = []
     for name in want:

@@ -139,6 +139,20 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2h: fast streaming leg -------------------------------------
+# sub-chunk streaming (-m streaming): device->host token ring round-trip,
+# sub-chunk vs packed-harvest parity (greedy + sampled, stops trimmed
+# identically), adaptive-chunk compile guard, mid-stream kill resume
+# through the fabric path with no duplicate/missing token.
+echo "== streaming (-m 'streaming and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'streaming and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: streaming leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 3: tier-1 tests (verbatim ROADMAP.md verify command) -------
 set -o pipefail
 rm -f /tmp/_t1.log

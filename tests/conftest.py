@@ -80,6 +80,11 @@ def pytest_configure(config):
         "tests (policy hysteresis/cooldown/guards, decision-ledger "
         "determinism, drain→swap→probe→rejoin, fleet admission shed; "
         "fast leg: pytest -m 'autoscale and not slow')")
+    config.addinivalue_line(
+        "markers", "streaming: sub-chunk streaming tests (device->host "
+        "token ring round-trip, sub-chunk vs packed-harvest parity, "
+        "adaptive-chunk compile guard, mid-stream failover resume; fast "
+        "leg: pytest -m 'streaming and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -31,6 +31,8 @@ from distributed_inference_engine_tpu.models.llama import llama_spec
 
 SPEC = llama_spec("llama-tiny", max_seq_len=64)
 
+pytestmark = pytest.mark.streaming
+
 
 def _model_cfg(name="m", continuous=True):
     meta = {"size": "llama-tiny", "page_size": 16, "num_pages": 64,
@@ -212,6 +214,200 @@ async def test_coordinator_stream_fails_over_before_first_chunk():
     finally:
         await coord.stop()
         await workers[1].stop()
+
+
+# ------------------------------------------- sub-chunk streaming (ISSUE 13)
+
+
+def _ecfg(**over):
+    kw = dict(max_slots=2, max_seq_len=64, page_size=16, num_pages=32,
+              decode_steps_per_call=4, attention_impl="xla")
+    kw.update(over)
+    return EngineConfig(**kw)
+
+
+def test_token_ring_roundtrip_bit_exact():
+    """defer_sync path: each chunk's emitted rows ride the device->host
+    ring and are harvested by poll_stream inside the host bubble; the
+    streamed concatenation must equal the packed-harvest result exactly."""
+    eng = ContinuousEngine(SPEC, config=_ecfg(defer_sync=True))
+    chunks = []
+    eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=12,
+                                 temperature=0.0, request_id="ring"),
+               on_tokens=chunks.append)
+    results = []
+    for _ in range(10000):
+        live = eng.step()
+        eng.poll_stream()               # the pump's host-bubble poll
+        results.extend(eng.drain_finished())
+        if live == 0 and not eng.n_waiting:
+            break
+    assert results and results[0].tokens
+    streamed = [t for c in chunks for t in c]
+    assert streamed == results[0].tokens        # bit-exact ring copy
+    m = eng.get_metrics()
+    assert m["stream_ring_pushes"] >= 1
+    assert m["stream_ring_polls"] >= 1
+
+
+def test_subchunk_greedy_parity_with_packed_harvest():
+    """Greedy decode is chunking-invariant: 1-step sub-chunks must yield
+    token-for-token the same output as the full 4-step megastep, and the
+    streamed frames must splice to exactly that."""
+
+    def run(scs, stream):
+        eng = ContinuousEngine(SPEC, config=_ecfg(stream_chunk_steps=scs))
+        chunks = []
+        eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=14,
+                                     temperature=0.0, request_id="g"),
+                   on_tokens=chunks.append if stream else None)
+        res = eng.run_until_idle()[0]
+        return res.tokens, [t for c in chunks for t in c]
+
+    ref, _ = run(0, stream=False)           # packed-harvest batch path
+    sub, streamed = run(1, stream=True)     # 1-step sub-chunks
+    assert sub == ref
+    assert streamed == sub
+
+
+def test_subchunk_stream_trims_stops_identically():
+    """A stop hit inside a sub-chunk must trim the stream exactly like the
+    packed path: stop token included, nothing after it leaks (greedy and
+    sampled-with-min_p=1.0, which pins sampling to the argmax)."""
+    probe = ContinuousEngine(SPEC, config=_ecfg()).generate(
+        [GenerationRequest(prompt=[1, 2, 3], max_new_tokens=12,
+                           temperature=0.0)])[0].tokens
+    stop = probe[5]
+    cut = probe.index(stop) + 1             # first occurrence, inclusive
+    for temp, min_p in ((0.0, 0.0), (0.8, 1.0)):
+        eng = ContinuousEngine(SPEC, config=_ecfg(stream_chunk_steps=1),
+                               seed=0)
+        chunks = []
+        eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=12,
+                                     temperature=temp, min_p=min_p,
+                                     stop_ids=[stop]),
+                   on_tokens=chunks.append)
+        res = eng.run_until_idle()[0]
+        assert res.tokens == probe[:cut]
+        assert res.finish_reason == "stop"
+        streamed = [t for c in chunks for t in c]
+        assert streamed == res.tokens       # no post-stop leakage
+
+
+def test_adaptive_chunk_compile_count_guard():
+    """The streaming clamp is pow2-bucketed: a mixed streaming+batch run
+    adds at most ONE new decode chunk length beyond the configured
+    megastep, and pure-batch slots keep the full chunk."""
+    eng = ContinuousEngine(SPEC, config=_ecfg(max_slots=4,
+                                              stream_chunk_steps=1))
+    # pure-batch wave first: full 4-step decode program only
+    eng.generate([GenerationRequest(prompt=[1, 2], max_new_tokens=8,
+                                    temperature=0.0)])
+    batch_steps = {p[1] for p in eng._tl_programs if p[0] == "decode"}
+    assert batch_steps == {4}
+    assert eng.get_metrics()["stream_clamped_chunks"] == 0
+    # streaming + batch mix: clamp engages, ONE extra length appears
+    chunks = []
+    eng.submit(GenerationRequest(prompt=[1, 2, 3], max_new_tokens=8,
+                                 temperature=0.0), on_tokens=chunks.append)
+    eng.submit(GenerationRequest(prompt=[4, 5], max_new_tokens=8,
+                                 temperature=0.0))
+    eng.run_until_idle()
+    decode_steps = {p[1] for p in eng._tl_programs if p[0] == "decode"}
+    assert decode_steps == {4, 1}, \
+        "clamp must add exactly one pow2 decode length"
+    assert eng.get_metrics()["stream_clamped_chunks"] >= 1
+    assert [t for c in chunks for t in c]
+
+
+def test_firsts_snapshot_one_fetch_per_rescue_wave():
+    """Regression for the hoisted per-slot ascontiguousarray recompute: a
+    whole retire wave shares at most ONE deferred-firsts readback, and a
+    cache hit costs zero host reads."""
+    eng = ContinuousEngine(SPEC, config=_ecfg(max_slots=4, defer_sync=True))
+    reqs = [GenerationRequest(prompt=[1 + i, 2, 3], max_new_tokens=6,
+                              temperature=0.0) for i in range(3)]
+    res = eng.generate(reqs)
+    assert all(len(r.tokens) == 6 for r in res)
+    # direct probe: one invalidation, two lookups, ONE fetch
+    eng._firsts_host = None
+    base = eng._firsts_fetches
+    a = eng._firsts_snapshot()
+    b = eng._firsts_snapshot()
+    assert a is b
+    assert eng._firsts_fetches == base + 1
+    assert eng.get_metrics()["firsts_fetches"] == eng._firsts_fetches
+
+
+@pytest.mark.asyncio
+async def test_midstream_kill_resumes_subchunk_through_fabric():
+    """Sub-chunk frames + mid-stream kill: the resume must replay from the
+    ring's high-water mark — token-exact, no duplicate or missing frame —
+    through the prefix-affinity/KV-fabric path, and the coordinator ITL
+    histogram must have observed the sub-chunk gaps."""
+    from distributed_inference_engine_tpu.models.fake import _chain
+
+    def expected(prompt, n, vocab=997):
+        st = 0
+        for t in prompt:
+            st = _chain(st, t)
+        out = []
+        for _ in range(n):
+            nxt = st % vocab
+            st = _chain(st, nxt)
+            out.append(nxt)
+        return out
+
+    coord = Coordinator(CoordinatorConfig(
+        lb_strategy="prefix_affinity", affinity_page_size=4,
+        affinity_pages=2, retry_seed=7, retry_backoff_base_s=0.01,
+        fabric_snapshot_delay_s=0.0))
+    await coord.start()
+    meta = {"continuous": 1, "max_slots": 4, "prefix_cache": 1,
+            "prefix_page_size": 4, "step_latency_s": 0.02,
+            "tokens_per_step": 4, "stream_chunk_tokens": 1}
+    cfg = ModelConfig(name="m", architecture="fake", metadata=meta)
+    workers = {}
+    try:
+        for i in range(2):
+            w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                          worker_id=f"w{i}"))
+            host, port = await w.start()
+            workers[f"w{i}"] = w
+            coord.add_worker(f"w{i}", host, port)
+        await coord.deploy_model(cfg)
+
+        got, killed = [], []
+
+        def on_tokens(toks):
+            got.append(list(toks))
+            if len(got) == 5 and not killed:
+                for wid, w in workers.items():
+                    if w._request_count:
+                        killed.append(wid)
+                        asyncio.ensure_future(w.stop())
+
+        prompt = [5, 6, 7, 8]
+        r = await coord.submit_stream("m", prompt=prompt, max_new_tokens=24,
+                                      on_tokens=on_tokens)
+        exp = expected(prompt, 24)
+        flat = [t for c in got for t in c]
+        assert killed, "the serving worker must have been killed mid-stream"
+        assert flat == exp, "replay must start at the ring high-water mark"
+        assert r["tokens"] == exp
+        assert r["metadata"].get("stream_resumed")
+        st = coord.get_stats()
+        assert st["stream_resumes"] == 1
+        assert st["stream_frames"] >= len(got)
+        assert st["stream_itl"]["count"] >= 1
+        assert st["stream_emit_lag"]
+    finally:
+        await coord.stop()
+        for w in workers.values():
+            try:
+                await w.stop()
+            except Exception:
+                pass
 
 
 @pytest.mark.asyncio
