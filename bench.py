@@ -103,7 +103,9 @@ Env knobs:
     SWEEP_REQUESTS / SWEEP_TRIALS / SWEEP_SHAPE; fleet_sweep reads
     SWEEP_LEGS (comma list to run a subset of
     replicated,disagg,affinity,kill,kvfabric,stream,autoscale,upgrade,
-    tiny).
+    multimodel,long,tiny; SWEEP_SHAPE=long raises the long leg's
+    prompts from 2k to 8k, SWEEP_SHAPE=moe points serving_sweep at the
+    capacity-bound int4 mixtral-16g rung).
 """
 
 import json

@@ -481,6 +481,7 @@ class Coordinator:
             # import into).
             await self.router.client_for(worker_id).load_model(
                 mcfg, timeout=self.config.supervisor_load_timeout_s)
+            self.lb.add_resident_model(worker_id, name)
             for s in shards:
                 s.status = ModelStatus.READY
         self.router.mark_worker_success(worker_id)
@@ -568,6 +569,9 @@ class Coordinator:
             # worker-side load is idempotent for an identical config and
             # errors on a mismatched one — no error-text sniffing needed
             await client.load_model(cfg, timeout=load_timeout_s)
+            # deploy-time residency hint so the LB's cold-key placement
+            # prefers this worker before the next health ping lands
+            self.lb.add_resident_model(wid, cfg.name)
             if register_shards:
                 self.registry.add_shard(
                     cfg.name, cfg.version, shard_id=next_id,
@@ -575,6 +579,59 @@ class Coordinator:
                 next_id += 1
             deployed += 1
         return deployed
+
+    async def stage_model(
+        self,
+        cfg: ModelConfig,
+        worker_ids: Optional[Sequence[str]] = None,
+        timeout_s: Optional[float] = None,
+    ) -> int:
+        """Start BACKGROUND staging of ``cfg`` on workers: each worker reads
+        the artifact and builds the engine on a side thread while its current
+        models keep serving (the stage never enters the dispatch executor).
+        Returns the number of workers that began staging (workers already
+        hosting an identical ``cfg.name`` are skipped). The model enters the
+        coordinator catalog immediately so model-qualified affinity keys and
+        tokenizer lookups resolve before the first swap lands.
+        """
+        targets = list(worker_ids) if worker_ids else list(self.router.workers)
+        if not targets:
+            raise RoutingError("no workers to stage onto")
+        if self.registry.get_model_version(cfg.name, cfg.version) is None:
+            self.registry.register_model(cfg)
+        self._model_configs[cfg.name] = cfg
+        staging = 0
+        for wid in targets:
+            res = await self.router.client_for(wid).stage_model(
+                cfg, timeout=timeout_s)
+            if not res.get("already_resident"):
+                staging += 1
+                self.lb.add_staged_model(wid, cfg.name)
+        return staging
+
+    async def swap_model(
+        self,
+        name: str,
+        worker_ids: Optional[Sequence[str]] = None,
+        probe: Optional[Sequence[int]] = None,
+        timeout_s: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Hot-swap a previously staged model in on workers: wait for the
+        background stage, run the golden-token probe gate, then admit the
+        engine (LRU-evicting idle residents over budget). Returns the
+        per-worker swap receipts (``stage_s``/``swap_s``/``evicted``...).
+        """
+        targets = list(worker_ids) if worker_ids else list(self.router.workers)
+        if not targets:
+            raise RoutingError("no workers to swap on")
+        receipts = []
+        for wid in targets:
+            rec = await self.router.client_for(wid).swap_model(
+                name, probe=probe, timeout=timeout_s)
+            rec["worker_id"] = wid
+            self.lb.add_resident_model(wid, name)
+            receipts.append(rec)
+        return receipts
 
     async def deploy_model_disaggregated(
         self,
@@ -626,6 +683,7 @@ class Coordinator:
             # is not set yet and re-deploy skips already-hosted shards
             await self.router.client_for(wid).load_model(
                 dcfg, timeout=load_timeout_s)
+            self.lb.add_resident_model(wid, cfg.name)
             if wid not in hosted:
                 self.registry.add_shard(cfg.name, cfg.version,
                                         shard_id=next_id, worker_id=wid,
@@ -650,12 +708,18 @@ class Coordinator:
                 return wid
         raise RoutingError("no healthy prefill worker")
 
-    def _prefix_affinity_key(self, prompt: Sequence[int]) -> Optional[str]:
-        """The request's routing key under ``prefix_affinity``: the chain
-        hash of its leading full prompt pages (capped at
-        ``affinity_pages``), hex-encoded so it rides ``inputs["key"]`` over
-        the wire. ``None`` when the strategy is different or the prompt is
-        shorter than one page — those requests spread normally."""
+    def _prefix_affinity_key(self, model: str,
+                             prompt: Sequence[int]) -> Optional[str]:
+        """The request's routing key under ``prefix_affinity``: the MODEL
+        id plus the chain hash of its leading full prompt pages (capped at
+        ``affinity_pages``), as ``"<model>:<hex>"`` so it rides
+        ``inputs["key"]`` over the wire. Qualifying the key by model keeps
+        multi-model fleets honest twice over: identical prompts under
+        different models never share a binding (their KV chains differ),
+        and the LB's cold-key placement can read the model id back out of
+        the key to prefer workers already holding (or staging) that model.
+        ``None`` when the strategy is different or the prompt is shorter
+        than one page — those requests spread normally."""
         if self.lb.strategy is not LoadBalancerStrategy.PREFIX_AFFINITY:
             return None
         page = self.config.affinity_page_size
@@ -666,7 +730,7 @@ class Coordinator:
         from ..engine.paged_kv import page_chain_hashes
 
         head = [int(t) for t in prompt[:n_pages * page]]
-        key = page_chain_hashes(head, n_pages, page)[-1].hex()
+        key = f"{model}:{page_chain_hashes(head, n_pages, page)[-1].hex()}"
         if self.config.kv_fabric:
             # remember the tokens behind the key: kv_export is asked by
             # prompt head, not by hash — the fabric needs both directions
@@ -697,6 +761,16 @@ class Coordinator:
 
     def _fabric_default_model(self) -> Optional[str]:
         return next(iter(self._model_configs), None)
+
+    def _model_of_key(self, key: str) -> Optional[str]:
+        """The model a composite affinity key belongs to. KV pages move
+        through the fabric strictly under this model id, so a migration or
+        pre-warm can never land one model's pages in another model's cache.
+        Legacy bare-hash keys fall back to the single-model default."""
+        model = self.lb.model_of_key(key)
+        if model is not None and model in self._model_configs:
+            return model
+        return self._fabric_default_model()
 
     def _fabric_cache_put(self, key: str, wire: Dict[str, Any]) -> None:
         self._fabric_cache[key] = wire
@@ -729,25 +803,27 @@ class Coordinator:
         host KV tier. Called before ``enter_half_open`` on respawn and
         scale-up so the trial probe admits against imported pages. Wires
         come from the snapshot cache, else a live export from the bound
-        worker. Never raises; returns the number of prefixes landed."""
+        worker. Each key's pages move under ITS OWN model (derived from
+        the composite key) — an explicit ``model`` argument instead
+        restricts the pre-warm to that model's bindings. Never raises;
+        returns the number of prefixes landed."""
         if not self._fabric_on():
-            return 0
-        if model is None:
-            model = self._fabric_default_model()
-        if model is None:
             return 0
         k = self.config.prewarm_top_k if top_k is None else top_k
         pushed = 0
         for key, bound in self.lb.top_bindings(k):
             if bound == worker_id:
                 continue
+            kmodel = self._model_of_key(key)
+            if kmodel is None or (model is not None and kmodel != model):
+                continue
             wire = self._fabric_cache.get(key)
             if wire is None:
-                wire = await self.fabric_pull(model, key, bound)
+                wire = await self.fabric_pull(kmodel, key, bound)
             if wire is None:
                 self._fabric_prewarm_failures += 1
                 continue
-            if await self._fabric_push(model, key, worker_id, wire):
+            if await self._fabric_push(kmodel, key, worker_id, wire):
                 pushed += 1
         return pushed
 
@@ -825,17 +901,20 @@ class Coordinator:
             return None
         target = min(survivors,
                      key=lambda s: s.active_connections).worker_id
-        model = self._fabric_default_model()
         warmed = 0
-        if model is not None:
-            for key in keys:
-                wire = self._fabric_cache.get(key)
-                if wire is None:
-                    wire = await self.fabric_pull(model, key, worker_id)
-                if wire is None:
-                    continue
-                if await self._fabric_push(model, key, target, wire):
-                    warmed += 1
+        for key in keys:
+            # each key migrates under its own model — a drain of a
+            # multi-model worker hands every model's pages off correctly
+            model = self._model_of_key(key)
+            if model is None:
+                continue
+            wire = self._fabric_cache.get(key)
+            if wire is None:
+                wire = await self.fabric_pull(model, key, worker_id)
+            if wire is None:
+                continue
+            if await self._fabric_push(model, key, target, wire):
+                warmed += 1
         # hand off ALL bindings, warm or not: the target is the new owner
         # either way and routing there keeps the table stable
         moved = self.lb.rebind_affinity(worker_id, target)
@@ -902,7 +981,7 @@ class Coordinator:
         # must spread via the keyless fallback instead of polluting the
         # binding table with one-shot request ids
         affinity = key if key is not None else \
-            self._prefix_affinity_key(prompt)
+            self._prefix_affinity_key(model, prompt)
         trace = RequestTrace(request_id=request_id)
         trace.mark("received")
 
@@ -1043,7 +1122,7 @@ class Coordinator:
         # must spread via the keyless fallback instead of polluting the
         # binding table with one-shot request ids
         affinity = key if key is not None else \
-            self._prefix_affinity_key(prompt)
+            self._prefix_affinity_key(model, prompt)
         trace = RequestTrace(request_id=request_id)
         trace.mark("received")
         # streams bypass the cache, so the degradation gate is the first

@@ -450,16 +450,24 @@ class FleetAutoscaler:
         self._spawn_n += 1
         host, port = await hook(wid, None)
         self.coord.add_worker(wid, host, int(port))
-        mcfg = self.coord._model_configs[self.model]
-        # artifact cold-start: the load RPC is the proof of life, exactly
-        # as in the supervisor's respawn path
-        await self.coord.deploy_model(mcfg, worker_ids=[wid],
-                                      register_shards=False,
-                                      load_timeout_s=self._load_timeout_s)
+        # a multi-model fleet scales up CATALOG-wide: the replacement must
+        # be able to serve every model its peers hold, or affinity failover
+        # routes a cold-model request to a worker that cannot take it. The
+        # tracked model loads first so its requests land soonest.
+        names = [self.model] + [n for n in self.coord._model_configs
+                                if n != self.model]
+        for name in names:
+            mcfg = self.coord._model_configs[name]
+            # artifact cold-start: the load RPC is the proof of life,
+            # exactly as in the supervisor's respawn path
+            await self.coord.deploy_model(mcfg, worker_ids=[wid],
+                                          register_shards=False,
+                                          load_timeout_s=self._load_timeout_s)
         self._managed.append(wid)
         # KV fabric pre-warm BEFORE half-open: the trial probe should hit
         # imported prefix pages, not pay a cold prefill (best-effort)
-        await self.coord.prewarm_worker(wid, model=self.model)
+        for name in names:
+            await self.coord.prewarm_worker(wid, model=name)
         # cautious rejoin: first pick is the trial probe
         self.coord.lb.enter_half_open(wid)
         self._scale_ups += 1

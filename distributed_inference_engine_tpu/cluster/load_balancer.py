@@ -126,6 +126,14 @@ class LoadBalancer:
         self._affinity_misses = 0
         self._affinity_rebinds = 0
         self._affinity_handoffs = 0   # bindings MOVED (KV fabric), not dropped
+        # model+prefix placement (multi-model fleets): composite keys are
+        # "<model>:<prefix-hash>", so hits/misses split per model, and the
+        # cold-prefix placement prefers workers that already hold (or are
+        # staging) the key's model — learned from ping payloads and
+        # coordinator deploy/stage notifications
+        self._model_affinity: Dict[str, Dict[str, int]] = {}
+        self._resident_models: Dict[str, set] = {}   # worker -> resident
+        self._staged_models: Dict[str, set] = {}     # worker -> staging
         self._strategies = {
             LoadBalancerStrategy.ROUND_ROBIN: self._round_robin,
             LoadBalancerStrategy.LEAST_CONNECTIONS: self._least_connections,
@@ -169,6 +177,8 @@ class LoadBalancer:
 
     def unregister_worker(self, worker_id: str) -> bool:
         stats = self.workers.pop(worker_id, None)
+        self._resident_models.pop(worker_id, None)
+        self._staged_models.pop(worker_id, None)
         if stats is not None:
             self.invalidate_affinity(worker_id)
         client = self._clients.pop(worker_id, None)
@@ -282,27 +292,90 @@ class LoadBalancer:
             return self._affine_pick(affinity, healthy)
         return self._strategies[self.strategy](healthy)
 
+    # -- model residency (multi-model fleets) --------------------------------
+
+    @staticmethod
+    def model_of_key(key: Hashable) -> Optional[str]:
+        """The model id a composite ``"<model>:<prefix-hash>"`` affinity
+        key names; None for legacy bare-hash keys."""
+        if isinstance(key, str) and ":" in key:
+            return key.split(":", 1)[0]
+        return None
+
+    def note_models(self, worker_id: str, resident=None, staged=None) -> None:
+        """Record which models a worker holds (and is staging) — fed by the
+        health loop's ping payloads and by the coordinator after deploys/
+        stage requests, and read by the cold-key placement preference."""
+        if worker_id not in self.workers:
+            return
+        if resident is not None:
+            self._resident_models[worker_id] = set(resident)
+        if staged is not None:
+            self._staged_models[worker_id] = set(staged)
+
+    def add_resident_model(self, worker_id: str, model: str) -> None:
+        """Merge one model into a worker's known-resident set (deploy-time
+        hint; the health loop's ping payloads overwrite with ground truth).
+        A model that just became resident is no longer merely staged."""
+        if worker_id not in self.workers:
+            return
+        self._resident_models.setdefault(worker_id, set()).add(model)
+        self._staged_models.get(worker_id, set()).discard(model)
+
+    def add_staged_model(self, worker_id: str, model: str) -> None:
+        """Merge one model into a worker's staging set — cold keys for that
+        model prefer a worker already staging it over a fully cold one."""
+        if worker_id not in self.workers:
+            return
+        self._staged_models.setdefault(worker_id, set()).add(model)
+
+    def workers_with_model(self, model: str) -> set:
+        return {wid for wid, models in self._resident_models.items()
+                if model in models}
+
+    def _model_count(self, model: Optional[str], field: str) -> None:
+        if model is None:
+            return
+        rec = self._model_affinity.setdefault(
+            model, {"hits": 0, "misses": 0, "rebinds": 0})
+        rec[field] += 1
+
     def _affine_pick(self, key: Hashable,
                      healthy: List[WorkerStats]) -> WorkerStats:
+        model = self.model_of_key(key)
         bound = self._affinity.get(key)
         if bound is not None:
             s = self.workers.get(bound)
             if s is not None and self._is_healthy(s):
                 self._affinity_hits += 1
+                self._model_count(model, "hits")
                 self._affinity.move_to_end(key)
                 return s
             # bound worker is gone/unhealthy: rebind, don't drop the request
             self._affinity_rebinds += 1
+            self._model_count(model, "rebinds")
         else:
             self._affinity_misses += 1
-        # cold-prefix placement: least-connections, tie-broken by how many
-        # bindings each worker already holds — bare active_connections ties
-        # to the first worker on an idle fleet, piling every cold prefix
-        # onto one replica
+            self._model_count(model, "misses")
+        # cold-key placement: prefer workers where the key's MODEL is
+        # already resident (swap is free) over ones merely staging it
+        # (swap is cheap and imminent) over the rest (placement triggers a
+        # cold load) — a cold-model request should not displace a resident
+        # model elsewhere when a warm replica has capacity. Within a tier:
+        # least-connections, tie-broken by how many bindings each worker
+        # already holds — bare active_connections ties to the first worker
+        # on an idle fleet, piling every cold prefix onto one replica
+        candidates = healthy
+        if model is not None:
+            resident = [w for w in healthy
+                        if model in self._resident_models.get(w.worker_id, ())]
+            staging = [w for w in healthy
+                       if model in self._staged_models.get(w.worker_id, ())]
+            candidates = resident or staging or healthy
         held = Counter(self._affinity.values())
-        s = min(healthy, key=lambda w: (w.active_connections,
-                                        held.get(w.worker_id, 0),
-                                        w.request_count))
+        s = min(candidates, key=lambda w: (w.active_connections,
+                                           held.get(w.worker_id, 0),
+                                           w.request_count))
         self._bind_affinity(key, s.worker_id)
         return s
 
@@ -448,6 +521,11 @@ class LoadBalancer:
             s.probe_failures += 1
             self._record_failure(s)
             return False
+        if isinstance(pong, dict):
+            # pings advertise the worker's resident + staging model sets —
+            # the model-aware cold-key placement's knowledge source
+            self.note_models(worker_id, resident=pong.get("models"),
+                             staged=pong.get("staged"))
         if isinstance(pong, dict) and pong.get("draining"):
             logger.debug("lb: %s is draining — held out of rotation",
                          worker_id)
@@ -490,4 +568,8 @@ class LoadBalancer:
             "affinity_rebinds": self._affinity_rebinds,
             "affinity_handoffs": self._affinity_handoffs,
             "affinity_bindings": len(self._affinity),
+            # per-model split of the composite-key hits/misses/rebinds
+            # (multi-model fleets; legacy bare-hash keys are unlabelled)
+            "affinity_models": {m: dict(rec) for m, rec
+                                in self._model_affinity.items()},
         }

@@ -1,0 +1,343 @@
+"""Per-worker multi-model residency: background staging + probe-gated swap.
+
+One fleet so far meant one model: every worker holds exactly the engines
+``deploy_model`` pushed at it, and switching models costs a cold
+``load_model`` (checkpoint read + prepare + warmup — minutes at real model
+scale, ``load_sleep_s`` on the fake). PRESERVE's observation (PAPERS.md) is
+that a serving worker has idle host resources while the accelerator decodes:
+the NEXT model's weights can be read and prepared in that shadow, so a model
+switch costs a pointer swap, not a cold start. The r13 artifact layer is the
+substrate — a staged load is an artifact restore (``prepare_params`` already
+skipped), and the same golden-token probe that gates artifact cold-starts
+gates every swap here, so a wrong-numerics model never serves.
+
+``ModelManager`` owns one worker's resident set:
+
+- ``engines``/``configs`` — the resident models (the worker aliases these
+  dicts, so its RPC surface — ``_engine_for``, drain, metrics — reads the
+  same state).
+- ``stage(cfg)`` — build the next model's engine on a daemon side thread
+  while the current pumps keep dispatching. Staging never runs on the
+  worker's engine executor (that would serialize behind — and ahead of —
+  generates) and never inside a pump step: it only competes for host I/O
+  and CPU, which is exactly the bubble the accelerator leaves. The serving
+  pumps' step counters are snapshotted around the stage so the overlap is
+  *accounted*, not assumed (``stage_overlap_steps``).
+- ``swap(name)`` — wait for the stage, golden-gate the engine (artifact
+  manifest probe when it has one, else a caller-supplied expected token
+  list), then admit it under the residency budget. A probe mismatch
+  discards the staged engine and raises ``ModelProbeError`` — the models
+  already resident keep serving.
+- LRU eviction — admission over ``max_resident_models``/``resident_bytes``
+  evicts the least-recently-*used* idle model (``touch`` on every routed
+  request keeps the order honest). A model with in-flight work is never
+  evicted (``busy_fn``), and neither is the model just admitted.
+
+The manager is engine-agnostic and jax-free at import (artifact helpers are
+imported lazily), so the fleet tests drive it with fake engines.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from ..config import ModelConfig
+from ..utils.tracing import LatencyStats
+
+logger = logging.getLogger(__name__)
+
+
+class ModelProbeError(RuntimeError):
+    """A staged engine failed its golden-token gate — it was discarded and
+    must not serve. The previously resident models are untouched."""
+
+
+class ModelStageError(RuntimeError):
+    """Staging failed (factory raised) or the model was never staged."""
+
+
+class _Staged:
+    """One in-flight background stage."""
+
+    __slots__ = ("cfg", "thread", "done", "engine", "error", "stage_s",
+                 "steps_at_start", "overlap_steps")
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        self.cfg = cfg
+        self.thread: Optional[threading.Thread] = None
+        self.done = threading.Event()
+        self.engine: Any = None
+        self.error: Optional[BaseException] = None
+        self.stage_s = 0.0
+        self.steps_at_start = 0
+        self.overlap_steps = 0
+
+
+def engine_size_bytes(cfg: ModelConfig, engine: Any) -> int:
+    """Byte estimate for one resident engine: ``metadata.size_bytes`` when
+    the deploy declares it (the fake path), else the parameter tree's bytes,
+    else 0 (unaccounted — only the count budget applies)."""
+    declared = cfg.metadata.get("size_bytes")
+    if declared:
+        return int(declared)
+    params = getattr(engine, "params", None)
+    if params is None:
+        return 0
+    try:
+        import jax
+
+        return int(sum(x.nbytes for x in jax.tree.leaves(params)
+                       if hasattr(x, "nbytes")))
+    # graftlint: ok[swallowed-transport-error] local size introspection, no peer involved; 0 just means the byte budget cannot see this engine
+    except Exception:
+        return 0
+
+
+class ModelManager:
+    """Resident-model policy for one worker (see module docstring)."""
+
+    def __init__(
+        self,
+        build: Callable[[ModelConfig], Any],
+        *,
+        max_resident_models: int = 0,
+        resident_bytes: int = 0,
+        busy_fn: Optional[Callable[[str], bool]] = None,
+        on_evict: Optional[Callable[[str, Any], None]] = None,
+    ) -> None:
+        self.build = build
+        self.max_resident_models = int(max_resident_models)
+        self.resident_bytes = int(resident_bytes)
+        self.busy_fn = busy_fn
+        self.on_evict = on_evict
+        self.engines: Dict[str, Any] = {}
+        self.configs: Dict[str, ModelConfig] = {}
+        self._lru: "OrderedDict[str, None]" = OrderedDict()
+        self._bytes: Dict[str, int] = {}
+        self._staged: Dict[str, _Staged] = {}
+        self._lock = threading.Lock()
+        self._stages_started = 0
+        self._stages_completed = 0
+        self._stages_failed = 0
+        self._swaps = 0
+        self._evictions = 0
+        self._probe_rejects = 0
+        self._stage_overlap_steps = 0
+        self.stage_stats = LatencyStats()
+        self.swap_stats = LatencyStats()
+
+    # -- residency ---------------------------------------------------------
+
+    def touch(self, name: str) -> None:
+        """Mark one resident model as just-used (LRU order source)."""
+        if name in self._lru:
+            self._lru.move_to_end(name)
+
+    def admit(self, cfg: ModelConfig, engine: Any) -> List[str]:
+        """Install an engine into the resident set; evict over-budget idle
+        models (LRU-first). Returns the evicted names. The newly admitted
+        model is never an eviction candidate."""
+        name = cfg.name
+        self.engines[name] = engine
+        self.configs[name] = cfg
+        self._bytes[name] = engine_size_bytes(cfg, engine)
+        self._lru[name] = None
+        self._lru.move_to_end(name)
+        return self._evict_over_budget(protect=name)
+
+    def remove(self, name: str) -> Optional[Any]:
+        """Drop one model from the resident set (explicit unload — not an
+        eviction). Returns the engine, or None if absent."""
+        self.configs.pop(name, None)
+        self._lru.pop(name, None)
+        self._bytes.pop(name, None)
+        return self.engines.pop(name, None)
+
+    def resident_bytes_used(self) -> int:
+        return sum(self._bytes.values())
+
+    def _over_budget(self) -> bool:
+        if self.max_resident_models and len(self.engines) > self.max_resident_models:
+            return True
+        if self.resident_bytes and self.resident_bytes_used() > self.resident_bytes:
+            return True
+        return False
+
+    def _evict_over_budget(self, protect: str) -> List[str]:
+        evicted: List[str] = []
+        while self._over_budget():
+            victim = None
+            for name in self._lru:            # LRU-first
+                if name == protect:
+                    continue
+                if self.busy_fn is not None and self.busy_fn(name):
+                    continue                  # in-flight work pins residency
+                victim = name
+                break
+            if victim is None:
+                # everything else is busy or protected: serving correctness
+                # beats the budget — stay over and let the next admit retry
+                logger.warning(
+                    "resident budget exceeded but every candidate is busy "
+                    "(%d models, %d bytes)", len(self.engines),
+                    self.resident_bytes_used())
+                break
+            engine = self.remove(victim)
+            self._evictions += 1
+            evicted.append(victim)
+            logger.info("evicted idle model %s (LRU, resident budget)",
+                        victim)
+            if self.on_evict is not None and engine is not None:
+                self.on_evict(victim, engine)
+        return evicted
+
+    # -- background staging ------------------------------------------------
+
+    def staged_names(self) -> List[str]:
+        return sorted(self._staged)
+
+    def stage(self, cfg: ModelConfig,
+              serving_steps: Optional[Callable[[], int]] = None) -> _Staged:
+        """Begin building ``cfg``'s engine on a side thread; returns the
+        stage record immediately (idempotent per name while in flight).
+        ``serving_steps`` is sampled at start and finish so the overlap
+        with live dispatch is measured, not assumed."""
+        name = cfg.name
+        with self._lock:
+            rec = self._staged.get(name)
+            if rec is not None:
+                return rec
+            rec = _Staged(cfg)
+            self._staged[name] = rec
+            self._stages_started += 1
+        if serving_steps is not None:
+            rec.steps_at_start = int(serving_steps())
+
+        def _run() -> None:
+            t0 = time.perf_counter()
+            try:
+                rec.engine = self.build(cfg)
+            except BaseException as e:      # surfaced at swap time
+                rec.error = e
+            rec.stage_s = time.perf_counter() - t0
+            if serving_steps is not None:
+                try:
+                    rec.overlap_steps = int(serving_steps()) - rec.steps_at_start
+                # graftlint: ok[swallowed-transport-error] local stats sampling, no peer involved; overlap accounting is best-effort
+                except Exception:
+                    rec.overlap_steps = 0
+            rec.done.set()
+
+        rec.thread = threading.Thread(
+            target=_run, daemon=True, name=f"stage-{name}")
+        rec.thread.start()
+        return rec
+
+    def stage_wait(self, name: str,
+                   timeout: Optional[float] = None) -> _Staged:
+        """Block until ``name``'s stage finishes; pops and returns the
+        record. Raises ``ModelStageError`` when never staged / timed out /
+        the factory failed."""
+        rec = self._staged.get(name)
+        if rec is None:
+            raise ModelStageError(
+                f"model {name!r} is not staged (staged: {self.staged_names()})")
+        if not rec.done.wait(timeout):
+            raise ModelStageError(
+                f"stage of {name!r} still running after {timeout}s")
+        with self._lock:
+            self._staged.pop(name, None)
+        self._stage_overlap_steps += rec.overlap_steps
+        if rec.error is not None:
+            self._stages_failed += 1
+            raise ModelStageError(
+                f"stage of {name!r} failed: {type(rec.error).__name__}: "
+                f"{rec.error}") from rec.error
+        self._stages_completed += 1
+        self.stage_stats.add(rec.stage_s)
+        return rec
+
+    # -- probe-gated swap --------------------------------------------------
+
+    def _golden_gate(self, engine: Any,
+                     probe_expected: Optional[List[int]]) -> None:
+        """The same trust boundary as an artifact cold-start: an engine
+        with a manifest replays its recorded golden generation; otherwise a
+        caller-supplied expected token list is replayed over the fixed
+        ``GOLDEN_PROMPT``. No gate available ⇒ admit (matching
+        ``load_model``, which has no probe either)."""
+        from ..engine.artifact import (
+            GOLDEN_PROMPT,
+            ArtifactCorruptError,
+            run_probe,
+            verify_golden,
+        )
+
+        manifest = getattr(engine, "artifact_manifest", None)
+        if manifest is not None:
+            try:
+                verify_golden(engine, manifest)
+                return
+            except ArtifactCorruptError as e:
+                raise ModelProbeError(str(e)) from e
+        if probe_expected:
+            want = [int(t) for t in probe_expected]
+            got = run_probe(engine, list(GOLDEN_PROMPT), len(want))
+            if got != want:
+                raise ModelProbeError(
+                    f"swap probe FAILED: expected {want}, got {got} — "
+                    "staged engine numerics are wrong, refusing to swap")
+
+    def swap(self, name: str,
+             probe_expected: Optional[List[int]] = None,
+             timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Activate a staged model: wait for its build, golden-gate it,
+        admit it under the budget. Returns a receipt dict with the
+        measured ``stage_s`` (background, overlapped) and ``swap_s`` (what
+        the caller actually waited — the number that must beat a cold
+        ``load_model`` by ~the artifact-restore ratio). On probe failure
+        the staged engine is discarded and the resident set is untouched."""
+        t0 = time.perf_counter()
+        if name in self.engines and name not in self._staged:
+            self.touch(name)
+            return {"swapped": name, "already_resident": True,
+                    "stage_s": 0.0, "swap_s": 0.0, "evicted": []}
+        rec = self.stage_wait(name, timeout=timeout)
+        try:
+            self._golden_gate(rec.engine, probe_expected)
+        except ModelProbeError:
+            self._probe_rejects += 1
+            raise
+        evicted = self.admit(rec.cfg, rec.engine)
+        swap_s = time.perf_counter() - t0
+        self._swaps += 1
+        self.swap_stats.add(swap_s)
+        logger.info(
+            "swapped in model %s: stage %.3fs (background, %d steps "
+            "overlapped), swap wait %.3fs, evicted %s", name, rec.stage_s,
+            rec.overlap_steps, swap_s, evicted or "none")
+        return {"swapped": name, "already_resident": False,
+                "stage_s": rec.stage_s, "swap_s": swap_s,
+                "overlap_steps": rec.overlap_steps, "evicted": evicted}
+
+    # -- introspection -----------------------------------------------------
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "resident_models": len(self.engines),
+            "resident_bytes": self.resident_bytes_used(),
+            "staged_models": len(self._staged),
+            "stage_started": self._stages_started,
+            "stage_completed": self._stages_completed,
+            "stage_failed": self._stages_failed,
+            "model_swaps": self._swaps,
+            "model_evictions": self._evictions,
+            "swap_probe_rejects": self._probe_rejects,
+            "stage_overlap_steps": self._stage_overlap_steps,
+            "model_stage": self.stage_stats.snapshot(),
+            "model_swap": self.swap_stats.snapshot(),
+        }

@@ -36,6 +36,7 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ..config import ModelConfig, ServerConfig
 from ..engine.types import GenerationRequest, GenerationResult
+from .model_manager import ModelManager, ModelProbeError, ModelStageError
 from ..utils.framing import FrameError, read_frame, write_frame
 from ..utils.rpc import (
     FramedRPCClient,
@@ -193,8 +194,18 @@ class WorkerServer(FramedServerMixin):
         self.config = config or ServerConfig()
         self.worker_id = self.config.worker_id
         self.engine_factory = engine_factory
-        self.engines: Dict[str, Any] = {}
-        self.model_configs: Dict[str, ModelConfig] = {}
+        # multi-model residency (cluster/model_manager.py): the manager
+        # owns the resident set + staging/swap/eviction policy; the worker
+        # aliases its dicts so every RPC path reads the same state
+        self.model_manager = ModelManager(
+            self._build_engine,
+            max_resident_models=self.config.max_resident_models,
+            resident_bytes=self.config.resident_bytes,
+            busy_fn=self._model_busy,
+            on_evict=self._on_model_evicted,
+        )
+        self.engines: Dict[str, Any] = self.model_manager.engines
+        self.model_configs: Dict[str, ModelConfig] = self.model_manager.configs
         self._pumps: Dict[str, Any] = {}    # model -> EnginePump (continuous)
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_writers: set = set()
@@ -244,6 +255,9 @@ class WorkerServer(FramedServerMixin):
             "kv_export": self._rpc_kv_export,
             "kv_import": self._rpc_kv_import,
             "load_model": self._rpc_load_model,
+            "stage_model": self._rpc_stage_model,
+            "swap_model": self._rpc_swap_model,
+            "resident_models": self._rpc_resident_models,
             "unload_model": self._rpc_unload_model,
             "list_models": self._rpc_list_models,
             "metrics": self._rpc_metrics,
@@ -316,29 +330,11 @@ class WorkerServer(FramedServerMixin):
 
     # -- model lifecycle (reference src/worker.py:164-184) ------------------
 
-    def load_model(self, cfg: ModelConfig) -> None:
-        if cfg.name in self.engines:
-            # idempotent when the MODEL IDENTITY matches (a worker preloaded
-            # via CLI is a valid deploy target even if its engine knobs —
-            # continuous, page sizes, batcher limits — differ from the deploy
-            # request's defaults); a different identity is a real error:
-            # silently serving mismatched weights corrupts placement
-            have = self.model_configs[cfg.name]
-            if _model_identity(have) != _model_identity(cfg):
-                raise ValueError(
-                    f"model {cfg.name!r} already loaded with a different config"
-                )
-            need, got = _engine_features(cfg), _engine_features(have)
-            if not need <= got:
-                raise ValueError(
-                    f"model {cfg.name!r} already loaded with features "
-                    f"{sorted(got)} but this deploy needs {sorted(need)} "
-                    "— unload it first"
-                )
-            logger.info("worker %s: model %s already loaded (idempotent)",
-                        self.worker_id, cfg.name)
-            return
-        t0 = time.perf_counter()
+    def _build_engine(self, cfg: ModelConfig):
+        """Factory + artifact accounting + warmup — the full engine build,
+        shared by the cold ``load_model`` path and the background staging
+        thread (so a staged engine arrives pre-warmed: the swap installs
+        it, it never compiles on the serving clock)."""
         engine = self.engine_factory(cfg)
         artifact_hit = getattr(engine, "artifact_manifest", None) is not None
         if cfg.metadata.get("artifact"):
@@ -358,9 +354,31 @@ class WorkerServer(FramedServerMixin):
                 n = engine.warmup()
             logger.info("worker %s warmed %s (%d rounds)",
                         self.worker_id, cfg.name, n)
-        self.engines[cfg.name] = engine
-        self.model_configs[cfg.name] = cfg
-        # continuous engines get a rolling-batch pump (serving/pump.py)
+        return engine
+
+    def _model_busy(self, name: str) -> bool:
+        """Eviction guard: a model with queued or decoding work is pinned
+        resident — evicting it would drop in-flight generations."""
+        pump = self._pumps.get(name)
+        if pump is not None and pump.get_stats().get("in_flight", 0) > 0:
+            return True
+        engine = self.engines.get(name)
+        if engine is not None and (getattr(engine, "n_live", 0)
+                                   or getattr(engine, "n_waiting", 0)):
+            return True
+        return False
+
+    def _on_model_evicted(self, name: str, engine) -> None:
+        pump = self._pumps.pop(name, None)
+        if pump is not None:
+            pump.shutdown_nowait()
+        logger.info("worker %s evicted model %s (resident budget)",
+                    self.worker_id, name)
+
+    def _install_engine(self, cfg: ModelConfig, engine) -> None:
+        """Admit a built engine into the resident set (budget-evicting idle
+        LRU models) and give continuous engines their rolling-batch pump."""
+        self.model_manager.admit(cfg, engine)
         if hasattr(engine, "submit") and hasattr(engine, "step"):
             from ..serving.pump import EnginePump
 
@@ -368,6 +386,41 @@ class WorkerServer(FramedServerMixin):
                 engine,
                 mixed_step_tokens=(
                     int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
+
+    def _check_idempotent(self, cfg: ModelConfig) -> bool:
+        """True when ``cfg`` is already loaded with a compatible config;
+        raises on an identity/feature mismatch (silently serving mismatched
+        weights corrupts placement)."""
+        if cfg.name not in self.engines:
+            return False
+        # idempotent when the MODEL IDENTITY matches (a worker preloaded
+        # via CLI is a valid deploy target even if its engine knobs —
+        # continuous, page sizes, batcher limits — differ from the deploy
+        # request's defaults); a different identity is a real error
+        have = self.model_configs[cfg.name]
+        if _model_identity(have) != _model_identity(cfg):
+            raise ValueError(
+                f"model {cfg.name!r} already loaded with a different config"
+            )
+        need, got = _engine_features(cfg), _engine_features(have)
+        if not need <= got:
+            raise ValueError(
+                f"model {cfg.name!r} already loaded with features "
+                f"{sorted(got)} but this deploy needs {sorted(need)} "
+                "— unload it first"
+            )
+        return True
+
+    def load_model(self, cfg: ModelConfig) -> None:
+        if self._check_idempotent(cfg):
+            logger.info("worker %s: model %s already loaded (idempotent)",
+                        self.worker_id, cfg.name)
+            self.model_manager.touch(cfg.name)
+            return
+        t0 = time.perf_counter()
+        engine = self._build_engine(cfg)
+        artifact_hit = getattr(engine, "artifact_manifest", None) is not None
+        self._install_engine(cfg, engine)
         load_s = time.perf_counter() - t0
         self.model_load_stats.add(load_s)
         self._last_load_s[cfg.name] = load_s
@@ -384,8 +437,7 @@ class WorkerServer(FramedServerMixin):
         await loop.run_in_executor(self._executor, self.load_model, cfg)
 
     def unload_model(self, name: str) -> bool:
-        engine = self.engines.pop(name, None)
-        self.model_configs.pop(name, None)
+        engine = self.model_manager.remove(name)
         pump = self._pumps.pop(name, None)
         if pump is not None:
             pump.shutdown_nowait()
@@ -393,6 +445,43 @@ class WorkerServer(FramedServerMixin):
             return False
         logger.info("worker %s unloaded model %s", self.worker_id, name)
         return True
+
+    # -- background staging + hot swap (cluster/model_manager.py) -----------
+
+    def _serving_steps(self) -> int:
+        """Total pump steps across every resident continuous engine — the
+        step-timeline clock staging overlap is accounted against."""
+        return sum(int(p.get_stats().get("steps", 0))
+                   for p in self._pumps.values())
+
+    def stage_model(self, cfg: ModelConfig):
+        """Begin staging ``cfg`` in the background (side thread; the
+        serving pumps keep dispatching). Idempotent while in flight; a
+        no-op returning None when the model is already resident."""
+        if cfg.name in self.engines and self._check_idempotent(cfg):
+            return None
+        return self.model_manager.stage(cfg,
+                                        serving_steps=self._serving_steps)
+
+    def swap_model(self, name: str,
+                   probe_expected: Optional[List[int]] = None,
+                   timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Activate a staged model: wait for its build, golden-gate it,
+        admit it (budget-evicting idle LRU models), give it a pump.
+        Synchronous — call off the event loop."""
+        receipt = self.model_manager.swap(name, probe_expected=probe_expected,
+                                          timeout=timeout)
+        if not receipt.get("already_resident"):
+            engine = self.engines[name]
+            cfg = self.model_configs[name]
+            if hasattr(engine, "submit") and hasattr(engine, "step"):
+                from ..serving.pump import EnginePump
+
+                self._pumps[name] = EnginePump(
+                    engine,
+                    mixed_step_tokens=(
+                        int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
+        return receipt
 
     # -- connection handling (loop + envelope in FramedServerMixin) -----------
 
@@ -417,7 +506,7 @@ class WorkerServer(FramedServerMixin):
         # compile, checkpoint load) — their deadline belongs to the caller.
         # The server-side timeout only guards the cheap control methods.
         # drain carries its own timeout_s in the message.
-        if method in ("generate", "load_model", "prefill",
+        if method in ("generate", "load_model", "swap_model", "prefill",
                       "generate_prefilled", "prefill_generate", "drain"):
             return await handler(msg)
         return await asyncio.wait_for(
@@ -462,6 +551,7 @@ class WorkerServer(FramedServerMixin):
         self._ping_count += 1
         return {"worker_id": self.worker_id, "time": time.time(),
                 "models": sorted(self.engines),
+                "staged": self.model_manager.staged_names(),
                 "draining": self._draining}
 
     def _admit(self) -> None:
@@ -618,6 +708,9 @@ class WorkerServer(FramedServerMixin):
                 f"model {name!r} engine ({type(engine).__name__}) does not "
                 f"support {capability!r} — wrong pool role?"
             )
+        # every routed request refreshes the model's LRU position, so the
+        # residency budget evicts genuinely idle models, not busy ones
+        self.model_manager.touch(name)
         return name, engine
 
     async def _rpc_prefill(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -978,6 +1071,46 @@ class WorkerServer(FramedServerMixin):
                 # re-loads report the original) — demo/supervisor receipts
                 "load_s": self._last_load_s.get(cfg.name, 0.0)}
 
+    async def _rpc_stage_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Begin background staging; returns immediately (the build runs on
+        a side thread — dispatch is never displaced). ``swap_model`` later
+        waits for it, probes it, and installs it."""
+        cfg = ModelConfig.from_dict(msg["config"])
+        rec = self.stage_model(cfg)
+        return {"staging": cfg.name,
+                "already_resident": rec is None}
+
+    async def _rpc_swap_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Activate a staged model (probe-gated). Runs on the engine
+        executor: the wait for the staging thread happens off the event
+        loop, and installation serializes with in-flight loads."""
+        name = msg.get("model")
+        if not name:
+            raise ValueError("missing 'model'")
+        probe = msg.get("probe")
+        timeout = msg.get("timeout_s")
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._executor,
+                lambda: self.swap_model(
+                    name,
+                    probe_expected=([int(t) for t in probe]
+                                    if probe else None),
+                    timeout=float(timeout) if timeout else None))
+        except (ModelProbeError, ModelStageError) as e:
+            # typed application errors — the RPC envelope carries them as
+            # failures without denting transport-level health
+            raise ValueError(str(e)) from e
+
+    async def _rpc_resident_models(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        return {"worker_id": self.worker_id,
+                "resident": sorted(self.engines),
+                "staged": self.model_manager.staged_names(),
+                "resident_bytes": self.model_manager.resident_bytes_used(),
+                "max_resident_models": self.config.max_resident_models,
+                "resident_bytes_budget": self.config.resident_bytes}
+
     async def _rpc_unload_model(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return {"unloaded": self.unload_model(msg["model"])}
 
@@ -1085,6 +1218,10 @@ class WorkerServer(FramedServerMixin):
             "model_load": self.model_load_stats.snapshot(),
             "artifact_hits": self._artifact_hits,
             "artifact_misses": self._artifact_misses,
+            # multi-model residency (cluster/model_manager.py): resident/
+            # staged gauges, stage/swap latency histograms, eviction and
+            # probe-reject counters, measured staging↔dispatch overlap
+            **self.model_manager.get_stats(),
             "models": {name: eng.get_metrics()
                        for name, eng in self.engines.items()},
             # pump stats without the engine sub-dict ("models" above
@@ -1194,13 +1331,39 @@ class WorkerClient(FramedRPCClient):
         return [result_from_dict(d) for d in result["results"]]
 
     async def load_model(self, cfg: ModelConfig,
-                         timeout: Optional[float] = None) -> None:
-        await self.call("load_model", config=cfg.to_dict(),
-                        timeout=timeout if timeout is not None else 300.0)
+                         timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Load ``cfg`` on the worker; returns the measured-load receipt
+        ({loaded, load_s}) — the cold-start half of the staged-swap
+        latency comparison."""
+        return await self.call("load_model", config=cfg.to_dict(),
+                               timeout=timeout if timeout is not None
+                               else 300.0)
 
     async def unload_model(self, name: str) -> bool:
         result = await self.call("unload_model", model=name)
         return bool(result["unloaded"])
+
+    async def stage_model(self, cfg: ModelConfig,
+                          timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Begin background staging on the worker; returns immediately."""
+        return await self.call("stage_model", config=cfg.to_dict(),
+                               timeout=timeout)
+
+    async def swap_model(self, name: str,
+                         probe: Optional[List[int]] = None,
+                         timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Activate a staged model; ``probe`` is the expected golden-probe
+        token list for engines without an artifact manifest. Returns the
+        worker's swap receipt ({swapped, stage_s, swap_s, evicted})."""
+        budget = timeout if timeout is not None else 300.0
+        return await self.call(
+            "swap_model", model=name,
+            probe=[int(t) for t in probe] if probe else None,
+            timeout_s=budget, timeout=budget + 10.0)
+
+    async def resident_models(self) -> Dict[str, Any]:
+        """The worker's resident + staged model sets and byte budget."""
+        return await self.call("resident_models")
 
     async def kv_export(self, model: str, tokens: List[int],
                         max_pages: int = 0,

@@ -325,6 +325,12 @@ class ServerConfig:
     worker_id: str = "worker-0"
     request_timeout: float = 30.0      # reference src/worker.py:93
     max_frame_bytes: int = 64 * 1024 * 1024
+    # multi-model residency budget (cluster/model_manager.py): how many
+    # engines one worker may hold at once and/or their total parameter
+    # bytes. Admission over either budget LRU-evicts idle models (never
+    # ones with in-flight work). 0 = unbounded.
+    max_resident_models: int = 0
+    resident_bytes: int = 0
 
 
 @dataclass

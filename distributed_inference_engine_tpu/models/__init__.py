@@ -71,6 +71,16 @@ def engine_from_config(cfg):
 
     arch = cfg.architecture.lower()
     if arch == "fake":
+        # load_sleep_s models the checkpoint-read + prepare cost a real
+        # cold start pays: a cold load_model eats it on the caller's
+        # clock, a background stage (cluster/model_manager.py) eats it on
+        # a side thread — the staged-swap-vs-cold-load receipts the
+        # multimodel fleet leg measures need a nonzero gap to compare
+        load_sleep = float(cfg.metadata.get("load_sleep_s", 0) or 0)
+        if load_sleep:
+            import time
+
+            time.sleep(load_sleep)
         if cfg.metadata.get("role") == "prefill":
             # prefill-pool fake: chain-consistent handoffs over the real
             # wire format, so disaggregated fleets test jax-free

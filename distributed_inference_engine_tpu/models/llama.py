@@ -51,6 +51,12 @@ _MOE_FAMILY = {
     # the single-chip MoE measurement config (README; BENCH_MODEL=
     # mixtral-small)
     "mixtral-small": (8, 1024, 16, 8, 3584, 32000, 1e6, 4096, 8, 2),
+    # capacity-bound rung (VERDICT.md "Next" #8): the largest 8-expert
+    # Mixtral shape whose packed-int4 weights (~6.5 GB for ~12.9B params)
+    # leave a 16 GB chip room for KV + activations at bs64. Measured via
+    # SWEEP_SHAPE=moe (examples/serving_sweep.py; protocol in
+    # docs/decode_profile.md)
+    "mixtral-16g": (28, 2560, 20, 4, 7168, 32000, 1e6, 4096, 8, 2),
     "mixtral-tiny": (4, 256, 8, 4, 256, 1024, 10000.0, 512, 4, 2),
 }
 

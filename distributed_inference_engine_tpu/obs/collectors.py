@@ -363,6 +363,30 @@ WORKER_TABLE = [                   # WorkerServer.get_metrics() top level
      "generate/generate_stream RPC wall time"),
     ("model_load", "worker_model_load_seconds", "h",
      "load_model wall time (artifact cold-start vs slow path)"),
+    ("resident_models", "worker_resident_models", "g",
+     "Models resident (engine built, serving-ready) on this worker"),
+    ("resident_bytes", "worker_resident_bytes", "g",
+     "Parameter bytes held by resident models"),
+    ("staged_models", "worker_staged_models", "g",
+     "Models staging in the background (built, not yet swapped in)"),
+    ("stage_started", "worker_stage_started", "c",
+     "Background model stages started"),
+    ("stage_completed", "worker_stage_completed", "c",
+     "Background model stages that finished building"),
+    ("stage_failed", "worker_stage_failed", "c",
+     "Background model stages that raised during build"),
+    ("model_swaps", "worker_model_swaps", "c",
+     "Hot swaps that activated a staged model"),
+    ("model_evictions", "worker_model_evictions", "c",
+     "Idle models evicted by the resident count/byte budget (LRU)"),
+    ("swap_probe_rejects", "worker_swap_probe_rejects", "c",
+     "Swaps refused by the golden-token probe (staged engine discarded)"),
+    ("stage_overlap_steps", "worker_stage_overlap_steps", "c",
+     "Engine steps served by resident models while a stage ran"),
+    ("model_stage", "worker_stage_seconds", "h",
+     "Background stage wall time (artifact restore off the dispatch path)"),
+    ("model_swap", "worker_model_swap_seconds", "h",
+     "swap_model wall time the caller observed (stage overlap excluded)"),
 ]
 
 # families whose label values are dynamic (declared here so the catalog
@@ -380,6 +404,12 @@ EXTRA_FAMILIES = [
      "Last inter-frame gap observed per worker on streamed frames"),
     ("autoscaler_decisions", "c", ("action",),
      "Scaling decisions by action: up / down / shed_on / shed_off"),
+    ("lb_model_affinity_hits", "c", ("model",),
+     "Model+prefix affinity picks that landed on the bound worker"),
+    ("lb_model_affinity_misses", "c", ("model",),
+     "Model+prefix affinity picks with no live binding (cold key)"),
+    ("lb_model_affinity_rebinds", "c", ("model",),
+     "Model+prefix bindings moved off a dead/drained worker"),
 ]
 
 _GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
@@ -524,6 +554,16 @@ def apply_lb(reg: MetricsRegistry, ls: Optional[Mapping[str, Any]]) -> None:
     if not ls:
         return
     _apply_table(reg, LB_TABLE, ls, (), {})
+    by_model = ls.get("affinity_models")
+    if isinstance(by_model, Mapping):
+        fams = {f: reg.counter(f"lb_model_affinity_{f}",
+                               CATALOG[f"lb_model_affinity_{f}"][2],
+                               ("model",))
+                for f in ("hits", "misses", "rebinds")}
+        for model, rec in by_model.items():
+            if isinstance(rec, Mapping):
+                for f, fam in fams.items():
+                    fam.labels(model=str(model)).set(float(rec.get(f, 0)))
     workers = ls.get("workers")
     if isinstance(workers, Mapping):
         for wid, ws in workers.items():

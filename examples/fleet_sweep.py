@@ -63,6 +63,19 @@ after a failover — must produce the same stream):
               and abort. Acceptance: 100% token-exact during the good
               rollout (zero dropped tokens), rollback proven, fleet still
               token-exact after the abort.
+  multimodel  2 fake models (distinct vocab → distinct crc chains) on a
+              2-worker fleet: model B stages in the BACKGROUND under live
+              model-A load (goodput must hold within 10% — staging rides a
+              side thread, never the dispatch executor), hot-swaps in
+              behind the golden-token probe, then both models serve
+              concurrently under interleaved model+prefix affinity load.
+              Acceptance: per-model token-exact, staged swap >= 5x faster
+              than a cold ``load_model``, per-model affinity hit rate >=
+              90%, two same-seed runs emit identical receipts.
+  long        long-context rung: 2048-token prompts (default policy;
+              SWEEP_SHAPE=long raises to 8192) through the coordinator
+              with per-token admission cost. Every result token-exact vs
+              the analytic chain; the row carries TTFT/ITL percentiles.
   tiny        llama-tiny (real jax engines, CPU-friendly): 1 prefill + 1
               decode worker disaggregated vs a plain continuous reference
               worker, same seeded random-init weights (init key 0), same
@@ -112,23 +125,23 @@ VOCAB = 997
 STEP_S = bench.FLEET_STEP_MS / 1e3
 
 
-def expected_tokens(prompt, n):
+def expected_tokens(prompt, n, vocab=VOCAB):
     st = 0
     for t in prompt:
         st = _chain(st, t)
     out = []
     for _ in range(n):
-        nxt = st % VOCAB
+        nxt = st % vocab
         st = _chain(st, nxt)
         out.append(nxt)
     return out
 
 
-def fake_cfg(**meta) -> ModelConfig:
+def fake_cfg(name="m", **meta) -> ModelConfig:
     md = {"continuous": 1, "max_slots": bench.FLEET_SLOTS,
           "step_latency_s": STEP_S}
     md.update(meta)
-    return ModelConfig(name="m", architecture="fake", metadata=md)
+    return ModelConfig(name=name, architecture="fake", metadata=md)
 
 
 async def start_fleet(n_workers, *, coord_cfg=None, prefix="w"):
@@ -172,11 +185,12 @@ async def worker_generated(coord, model="m"):
 
 
 async def drive(coord, prompts, rate, new_tokens, seed, model="m",
-                mid_load_hook=None):
+                mid_load_hook=None, tag="r"):
     """Poisson arrivals at ``rate`` req/s; returns (results, wall_s,
     ttfts, itls) with results aligned to ``prompts``. ``mid_load_hook``
     (an async callable) fires once ~a third of the way into the arrival
-    schedule — the kill leg's sabotage slot."""
+    schedule — the kill leg's sabotage slot. ``tag`` prefixes request
+    ids so concurrent drives (the multimodel leg) don't collide."""
     rs = np.random.RandomState(seed)
     tasks = []
     fire_at = len(prompts) // 3
@@ -184,7 +198,7 @@ async def drive(coord, prompts, rate, new_tokens, seed, model="m",
     for i, p in enumerate(prompts):
         tasks.append(asyncio.ensure_future(coord.submit(
             model, prompt=p, max_new_tokens=new_tokens,
-            request_id=f"r{i}", no_cache=True)))
+            request_id=f"{tag}{i}", no_cache=True)))
         if mid_load_hook is not None and i == fire_at:
             await mid_load_hook()
             mid_load_hook = None
@@ -201,12 +215,12 @@ async def drive(coord, prompts, rate, new_tokens, seed, model="m",
     return results, wall, ttfts, itls
 
 
-def score(prompts, results, new_tokens):
+def score(prompts, results, new_tokens, vocab=VOCAB):
     ok, toks = 0, 0
     for p, r in zip(prompts, results):
         if isinstance(r, dict):
             toks += len(r.get("tokens", ()))
-            if r.get("tokens") == expected_tokens(p, new_tokens):
+            if r.get("tokens") == expected_tokens(p, new_tokens, vocab):
                 ok += 1
     return ok, toks
 
@@ -1016,17 +1030,219 @@ async def leg_stream():
     return rows
 
 
+async def _multimodel_once(run_tag):
+    """One seeded pass of the multimodel leg. Returns (rows, receipt)
+    where the receipt is the canonical (tag, tokens) ledger — two
+    same-seed passes must produce identical receipts."""
+    from distributed_inference_engine_tpu.engine.artifact import (
+        GOLDEN_PROMPT,
+    )
+    n = 2
+    page = 64
+    nt = bench.FLEET_NEW_TOKENS
+    lat = 5e-4
+    load_sleep = 0.5    # the fake's cold checkpoint-read cost
+    vocab_b = 1009      # distinct vocab -> distinct crc token chain
+    ma = fake_cfg(name="ma", prefix_cache=1, prefix_page_size=page,
+                  admit_latency_per_token_s=lat, load_sleep_s=load_sleep)
+    mb = fake_cfg(name="mb", vocab_size=vocab_b, prefix_cache=1,
+                  prefix_page_size=page, admit_latency_per_token_s=lat,
+                  load_sleep_s=load_sleep)
+    coord_cfg = CoordinatorConfig(
+        lb_strategy="prefix_affinity", affinity_page_size=page,
+        affinity_pages=2, retry_seed=bench.FLEET_SEED,
+        retry_backoff_base_s=0.01)
+    coord, workers = await start_fleet(n, coord_cfg=coord_cfg,
+                                       prefix=f"{run_tag}w")
+    rate = 0.4 * bench.FLEET_SLOTS / STEP_S / nt * n
+    receipt, rows = [], []
+    try:
+        await coord.deploy_model(ma, register_shards=False)
+
+        # -- phase 1: single-model baseline goodput for ma
+        p1 = _affinity_prompts(8, 8, 2 * page, bench.FLEET_SEED + 501)
+        r1, w1, t1, _ = await drive(coord, p1, rate, nt,
+                                    bench.FLEET_SEED + 501, model="ma",
+                                    tag="ma1_")
+        ok1, toks1 = score(p1, r1, nt)
+        assert ok1 == len(p1), f"baseline: {ok1}/{len(p1)} exact"
+        receipt += [("base", tuple(r["tokens"])) for r in r1]
+        goodput_base = toks1 / w1
+
+        # -- phase 2: stage mb in the BACKGROUND and immediately re-drive
+        # ma — staging must not displace dispatch, so goodput holds
+        staged = await coord.stage_model(mb)
+        assert staged == n, f"staging started on {staged}/{n} workers"
+        p2 = _affinity_prompts(8, 8, 2 * page, bench.FLEET_SEED + 502)
+        r2, w2, t2, _ = await drive(coord, p2, rate, nt,
+                                    bench.FLEET_SEED + 502, model="ma",
+                                    tag="ma2_")
+        ok2, toks2 = score(p2, r2, nt)
+        assert ok2 == len(p2), f"staged drive: {ok2}/{len(p2)} exact"
+        receipt += [("staged", tuple(r["tokens"])) for r in r2]
+        goodput_staged = toks2 / w2
+        goodput_frac = goodput_staged / max(goodput_base, 1e-9)
+        assert goodput_frac >= 0.9, \
+            f"goodput fell to {goodput_frac:.1%} of baseline while a " \
+            f"stage was in flight (floor 90%)"
+
+        # -- phase 3: probe-gated hot swap-in on every worker, then a cold
+        # load_model of the same-shaped model for the latency receipt
+        probe = expected_tokens(list(GOLDEN_PROMPT), 8, vocab=vocab_b)
+        swaps = await coord.swap_model("mb", probe=probe)
+        assert all(not s["already_resident"] for s in swaps)
+        swap_s = max(s["swap_s"] for s in swaps)
+        overlap = 0
+        for wid in list(coord.router.workers):
+            m = await coord.router.client_for(wid).metrics()
+            overlap += int(m.get("stage_overlap_steps", 0))
+            assert set(m.get("models", {})) == {"ma", "mb"}, \
+                f"{wid} resident set {set(m.get('models', {}))}"
+        assert overlap > 0, "stage overlapped zero serving steps"
+        wid0 = next(iter(workers))
+        cold = await coord.router.client_for(wid0).load_model(
+            fake_cfg(name="mcold", vocab_size=vocab_b,
+                     load_sleep_s=load_sleep))
+        cold_s = float(cold["load_s"])
+        speedup = cold_s / max(swap_s, 1e-9)
+        assert speedup >= 5.0, \
+            f"staged swap only {speedup:.1f}x faster than cold load " \
+            f"(acceptance >= 5x)"
+
+        # -- phase 4: both models serving CONCURRENTLY under interleaved
+        # affinity load; per-model token-exactness and per-model+prefix
+        # affinity hit rate
+        pa = _affinity_prompts(6, 10, 2 * page, bench.FLEET_SEED + 503)
+        pb = _affinity_prompts(6, 10, 2 * page, bench.FLEET_SEED + 504)
+        # snapshot per-model counters so the hit rate scores THIS phase's
+        # interleaved load, not the earlier phases' first-touch misses
+        before = {m: dict(rec) for m, rec in
+                  coord.lb.get_all_stats()["affinity_models"].items()}
+        (ra, wa, ta, _), (rb, wb, tb, _) = await asyncio.gather(
+            drive(coord, pa, rate / 2, nt, bench.FLEET_SEED + 503,
+                  model="ma", tag="mma_"),
+            drive(coord, pb, rate / 2, nt, bench.FLEET_SEED + 504,
+                  model="mb", tag="mmb_"))
+        ok_a, toks_a = score(pa, ra, nt)
+        ok_b, toks_b = score(pb, rb, nt, vocab=vocab_b)
+        assert ok_a == len(pa), f"model ma: {ok_a}/{len(pa)} exact"
+        assert ok_b == len(pb), f"model mb: {ok_b}/{len(pb)} exact"
+        receipt += [("ma", tuple(r["tokens"])) for r in ra]
+        receipt += [("mb", tuple(r["tokens"])) for r in rb]
+        per_model = coord.lb.get_all_stats()["affinity_models"]
+        hit_rates = {}
+        for mname in ("ma", "mb"):
+            rec = per_model.get(mname, {"hits": 0, "misses": 0})
+            b = before.get(mname, {"hits": 0, "misses": 0})
+            hits = rec["hits"] - b.get("hits", 0)
+            misses = rec["misses"] - b.get("misses", 0)
+            hit_rates[mname] = hits / max(1, hits + misses)
+        rows.append(emit({
+            "leg": "multimodel", "run": run_tag, "workers": n,
+            "models": 2, "requests": len(p1) + len(p2) + len(pa) + len(pb),
+            "token_exact": ok1 + ok2 + ok_a + ok_b,
+            "token_exact_frac": 1.0,
+            "goodput_base_toks": round(goodput_base, 1),
+            "goodput_while_staging_toks": round(goodput_staged, 1),
+            "staging_goodput_frac": round(goodput_frac, 4),
+            "stage_overlap_steps": overlap,
+            "swap_s": round(swap_s, 4),
+            "cold_load_s": round(cold_s, 4),
+            "swap_speedup": round(speedup, 1),
+            "affinity_hit_rate_ma": round(hit_rates["ma"], 4),
+            "affinity_hit_rate_mb": round(hit_rates["mb"], 4),
+        }))
+        for mname, hr in hit_rates.items():
+            assert hr >= 0.9, \
+                f"model {mname} affinity hit rate {hr:.1%} (floor 90%)"
+    finally:
+        await stop_fleet(coord, workers)
+    return rows, receipt
+
+
+async def leg_multimodel():
+    """Multi-model worker leg (ISSUE 14): two fake models with distinct
+    crc token chains share a 2-worker fleet. Background-stages the second
+    model under live load (goodput must hold within 10%), hot-swaps it in
+    behind the golden-token probe (staged swap >= 5x faster than a cold
+    ``load_model``), then serves BOTH models concurrently — per-model
+    token-exact, per-model+prefix affinity hit rate >= 90%. Runs TWICE
+    with the same seed; the token receipts must be identical."""
+    rows_a, receipt_a = await _multimodel_once("a")
+    rows_b, receipt_b = await _multimodel_once("b")
+    assert receipt_a == receipt_b, \
+        "same-seed multimodel runs produced different token receipts"
+    h = zlib.crc32(repr(receipt_a).encode()) & 0xFFFFFFFF
+    ra = rows_a[0]
+    log(f"  multimodel: both models token-exact, staged swap "
+        f"{ra['swap_s'] * 1e3:.0f} ms vs cold load "
+        f"{ra['cold_load_s'] * 1e3:.0f} ms ({ra['swap_speedup']}x, "
+        f"acceptance >= 5x); goodput while staging "
+        f"{ra['staging_goodput_frac']:.1%} of baseline (floor 90%); "
+        f"hit rates ma {ra['affinity_hit_rate_ma']:.1%} / mb "
+        f"{ra['affinity_hit_rate_mb']:.1%} (floor 90%); receipts "
+        f"identical (crc32 {h:#010x})")
+    rows = rows_a + rows_b
+    rows.append(emit({"leg": "multimodel", "summary": True,
+                      "receipt_crc32": h, "receipts_identical": True,
+                      "swap_speedup": ra["swap_speedup"],
+                      "staging_goodput_frac": ra["staging_goodput_frac"]}))
+    dump_leg("multimodel", rows)
+    return rows
+
+
+async def leg_long():
+    """Long-context rung: 2k-token prompts (the DEFAULT policy; set
+    SWEEP_SHAPE=long for the full 8k row) flow through the coordinator
+    to a 2-worker fleet with per-token admission cost — the framed RPC
+    path, affinity keys and crc reference chain all exercised at depth.
+    Every result must be token-exact against the analytic chain."""
+    n = 2
+    nt = 32
+    plen = 8192 if os.environ.get("SWEEP_SHAPE", "") == "long" else 2048
+    lat = 2e-5   # admission cost per uncached prompt token
+    page = 64
+    cfg = fake_cfg(prefix_cache=1, prefix_page_size=page,
+                   admit_latency_per_token_s=lat)
+    coord, workers = await start_fleet(n, coord_cfg=CoordinatorConfig(
+        lb_strategy="prefix_affinity", affinity_page_size=page,
+        affinity_pages=2, retry_seed=bench.FLEET_SEED,
+        retry_backoff_base_s=0.01))
+    await coord.deploy_model(cfg, register_shards=False)
+    rs = np.random.RandomState(bench.FLEET_SEED + 601)
+    prompts = [[int(t) for t in rs.randint(1, VOCAB, plen - 1)] + [i]
+               for i in range(24)]
+    rate = 0.4 * bench.FLEET_SLOTS / STEP_S / nt * n
+    gen0 = await worker_generated(coord)
+    results, wall, ttfts, itls = await drive(
+        coord, prompts, rate, nt, bench.FLEET_SEED + 601, tag="lg")
+    gen1 = await worker_generated(coord)
+    row = row_base("long", n, wall, prompts, results, ttfts, itls,
+                   nt, rate, gen0, gen1)
+    row["prompt_len"] = plen
+    log(f"  long: {row['token_exact']}/{row['requests']} token-exact at "
+        f"prompt_len={plen} (default policy 2048; SWEEP_SHAPE=long for "
+        f"8192), TTFT p50 {row['ttft_p50_ms']} ms")
+    assert row["token_exact"] == len(prompts), \
+        f"long-context: {row['token_exact']}/{len(prompts)} exact"
+    rows = [emit(row)]
+    await stop_fleet(coord, workers)
+    dump_leg("long", rows)
+    return rows
+
+
 LEGS = {"replicated": leg_replicated, "disagg": leg_disagg,
         "affinity": leg_affinity, "kill": leg_kill,
         "kvfabric": leg_kvfabric, "stream": leg_stream,
-        "autoscale": leg_autoscale, "upgrade": leg_upgrade}
+        "autoscale": leg_autoscale, "upgrade": leg_upgrade,
+        "multimodel": leg_multimodel, "long": leg_long}
 
 
 async def main_async():
     want = [s for s in os.environ.get(
         "SWEEP_LEGS",
         "replicated,disagg,affinity,kill,kvfabric,stream,autoscale,"
-        "upgrade,tiny"
+        "upgrade,multimodel,long,tiny"
     ).split(",") if s]
     all_rows = []
     for name in want:

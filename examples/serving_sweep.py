@@ -56,6 +56,21 @@ if os.environ.get("SWEEP_SHAPE", "") == "long":
 # budget; compare against BENCH_ATTN=xla (alternating dispatch) to see the
 # cliff this shape exists to measure. fp8 KV for the same capacity reason
 # as the long rung.
+# SWEEP_SHAPE=moe (ISSUE 14 / VERDICT.md "Next" #8): the capacity-bound
+# MoE rung — mixtral-16g (12.9B params, 8 experts, top-2) is the largest
+# Mixtral shape whose int4 weights (~6.0 GiB) leave a 16 GB chip room
+# for KV + activations at bs64. BENCH_QUANT=4 is EXPLICIT here: the
+# Mosaic kernel disengages on the 4-D expert mats (resolve_quant's
+# honored-but-logged path), so expert matmuls ride XLA int4 — the
+# capacity-vs-expert-throughput trade this rung exists to measure. On
+# CPU this shrinks to a parity check; the hardware capture protocol is
+# in docs/decode_profile.md ("Capacity-bound MoE rung").
+if os.environ.get("SWEEP_SHAPE", "") == "moe":
+    os.environ.setdefault("BENCH_MODEL", "mixtral-16g")
+    os.environ.setdefault("BENCH_QUANT", "4")
+    os.environ.setdefault("BENCH_PROMPT", "128")
+    os.environ.setdefault("BENCH_NEW_TOKENS", "128")
+    os.environ.setdefault("BENCH_KV_DTYPE", "float8_e4m3fn")
 if os.environ.get("SWEEP_SHAPE", "") == "mixed":
     os.environ.setdefault("BENCH_PROMPT", "128")
     os.environ.setdefault("BENCH_NEW_TOKENS", "128")

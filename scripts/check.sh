@@ -153,6 +153,20 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2i: fast multimodel leg ------------------------------------
+# multi-model workers (-m multimodel): resident-budget LRU eviction,
+# background stage never displacing dispatch, golden-probe-gated hot
+# swap, model-qualified affinity keys + KV isolation, supervisor respawn
+# reloading the full resident catalog.
+echo "== multimodel (-m 'multimodel and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'multimodel and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: multimodel leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 3: tier-1 tests (verbatim ROADMAP.md verify command) -------
 set -o pipefail
 rm -f /tmp/_t1.log

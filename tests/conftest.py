@@ -85,6 +85,11 @@ def pytest_configure(config):
         "token ring round-trip, sub-chunk vs packed-harvest parity, "
         "adaptive-chunk compile guard, mid-stream failover resume; fast "
         "leg: pytest -m 'streaming and not slow')")
+    config.addinivalue_line(
+        "markers", "multimodel: multi-model worker tests (resident-budget "
+        "LRU eviction, background stage never blocks dispatch, probe-gated "
+        "hot swap, model-qualified affinity/KV isolation, respawn reloads "
+        "the resident set; fast leg: pytest -m 'multimodel and not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

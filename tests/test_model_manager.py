@@ -1,0 +1,341 @@
+"""Multi-model worker tests (-m multimodel): resident-budget LRU
+eviction, background staging that never displaces dispatch, the
+golden-probe swap gate, model-qualified affinity routing + KV isolation,
+and supervisor respawn reloading the full resident catalog.
+
+Unit tests drive ``ModelManager`` directly with fake engines (the
+manager is jax-free at import); the integration tests run real
+WorkerServers over framed RPC through the coordinator, with per-model
+token-exactness checked against the crc32 chain — two models with
+different vocabs have DIFFERENT chains, so any cross-model mixing in
+routing, KV, or swap shows up as a token divergence.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_inference_engine_tpu.api.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.model_manager import (
+    ModelManager,
+    ModelProbeError,
+    ModelStageError,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+from distributed_inference_engine_tpu.config import (
+    HealthConfig,
+    ModelConfig,
+    ServerConfig,
+)
+from distributed_inference_engine_tpu.engine.artifact import GOLDEN_PROMPT
+from distributed_inference_engine_tpu.models import engine_from_config
+from distributed_inference_engine_tpu.models.fake import _chain
+
+pytestmark = pytest.mark.multimodel
+
+VOCAB_A = 997
+VOCAB_B = 1009
+
+
+def expected_tokens(prompt, n, vocab=VOCAB_A):
+    st = 0
+    for t in prompt:
+        st = _chain(st, t)
+    out = []
+    for _ in range(n):
+        nxt = st % vocab
+        st = _chain(st, nxt)
+        out.append(nxt)
+    return out
+
+
+def fake_cfg(name="m", **meta):
+    md = {"continuous": 1, "max_slots": 4}
+    md.update(meta)
+    return ModelConfig(name=name, architecture="fake", metadata=md)
+
+
+def golden_probe(vocab):
+    """What a healthy engine of ``vocab`` must emit over GOLDEN_PROMPT."""
+    return expected_tokens(list(GOLDEN_PROMPT), 8, vocab=vocab)
+
+
+# --------------------------------------------------- ModelManager (unit)
+
+def test_lru_eviction_under_count_budget():
+    """Over the count budget the LEAST-RECENTLY-USED idle model goes;
+    ``touch`` refreshes recency, so the routed-to model survives."""
+    gone = []
+    mm = ModelManager(engine_from_config, max_resident_models=2,
+                      on_evict=lambda name, eng: gone.append(name))
+    for name in ("a", "b", "c"):
+        cfg = fake_cfg(name=name)
+        mm.admit(cfg, engine_from_config(cfg))
+    assert gone == ["a"]
+    assert set(mm.engines) == {"b", "c"}
+    assert mm.get_stats()["model_evictions"] == 1
+    mm.touch("b")                      # b just served a request
+    cfg = fake_cfg(name="d")
+    evicted = mm.admit(cfg, engine_from_config(cfg))
+    assert evicted == ["c"] and gone == ["a", "c"]
+    assert set(mm.engines) == {"b", "d"}
+
+
+def test_byte_budget_eviction():
+    """The byte budget uses the deploy-declared ``size_bytes`` and evicts
+    LRU-first until the resident set fits."""
+    mm = ModelManager(engine_from_config, resident_bytes=250)
+    for name in ("a", "b", "c"):
+        cfg = fake_cfg(name=name, size_bytes=100)
+        mm.admit(cfg, engine_from_config(cfg))
+    assert set(mm.engines) == {"b", "c"}
+    assert mm.resident_bytes_used() == 200
+    st = mm.get_stats()
+    assert st["resident_models"] == 2 and st["resident_bytes"] == 200
+
+
+def test_busy_model_is_never_evicted():
+    """In-flight work pins residency: when every candidate is busy the
+    manager stays over budget rather than evicting a serving model."""
+    busy = {"a"}
+    mm = ModelManager(engine_from_config, max_resident_models=1,
+                      busy_fn=lambda name: name in busy)
+    for name in ("a", "b"):
+        cfg = fake_cfg(name=name)
+        mm.admit(cfg, engine_from_config(cfg))
+    # a is LRU but busy; b is the new admit (protected) — nobody goes
+    assert set(mm.engines) == {"a", "b"}
+    assert mm.get_stats()["model_evictions"] == 0
+    busy.clear()                       # a drains; next admit collects it
+    cfg = fake_cfg(name="c")
+    assert mm.admit(cfg, engine_from_config(cfg)) == ["a", "b"]
+    assert set(mm.engines) == {"c"}
+
+
+def test_stage_failure_surfaces_typed_error():
+    """A factory crash rides the stage record and surfaces as
+    ``ModelStageError`` at swap time; never-staged names fail fast."""
+    def boom(cfg):
+        raise RuntimeError("corrupt artifact payload")
+
+    mm = ModelManager(boom)
+    mm.stage(fake_cfg(name="x"))
+    with pytest.raises(ModelStageError, match="corrupt artifact"):
+        mm.stage_wait("x", timeout=5.0)
+    st = mm.get_stats()
+    assert st["stage_started"] == 1 and st["stage_failed"] == 1
+    with pytest.raises(ModelStageError, match="not staged"):
+        mm.stage_wait("never-staged", timeout=0.1)
+
+
+def test_probe_gated_swap_rejects_wrong_numerics():
+    """A staged engine whose golden-probe tokens diverge (vocab 991 ≠ the
+    expected 997 chain) is DISCARDED: swap raises, the resident set and
+    the reject counter both show it, and a correct engine still swaps."""
+    mm = ModelManager(engine_from_config)
+    good = fake_cfg(name="good")
+    mm.admit(good, engine_from_config(good))
+    mm.stage(fake_cfg(name="bad", vocab_size=991))
+    with pytest.raises(ModelProbeError, match="probe FAILED"):
+        mm.swap("bad", probe_expected=golden_probe(VOCAB_A))
+    assert set(mm.engines) == {"good"}
+    assert mm.get_stats()["swap_probe_rejects"] == 1
+    # the probe consumes the staged record — the gate cannot be retried
+    # into admitting the same rejected build
+    assert mm.staged_names() == []
+    mm.stage(fake_cfg(name="ok", vocab_size=VOCAB_B))
+    receipt = mm.swap("ok", probe_expected=golden_probe(VOCAB_B))
+    assert receipt["swapped"] == "ok" and not receipt["already_resident"]
+    assert set(mm.engines) == {"good", "ok"}
+
+
+def test_worker_budget_evicts_idle_on_swap():
+    """Worker-level wiring of the ``ServerConfig`` budget knobs: with
+    ``max_resident_models=1`` a swap-in evicts the idle previous model
+    and tears down its pump."""
+    w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                  worker_id="wb", max_resident_models=1))
+    try:
+        w.load_model(fake_cfg(name="ma"))
+        assert w.stage_model(fake_cfg(name="mb", vocab_size=VOCAB_B))
+        receipt = w.swap_model("mb", probe_expected=golden_probe(VOCAB_B),
+                               timeout=10.0)
+        assert receipt["evicted"] == ["ma"]
+        assert set(w.engines) == {"mb"}
+        assert set(w._pumps) == {"mb"}
+    finally:
+        for name in list(w.engines):
+            w.unload_model(name)
+
+
+# ------------------------------------------------ fleet (over framed RPC)
+
+async def start_fleet(n_workers, **coord_overrides):
+    kw = dict(lb_strategy="prefix_affinity", affinity_page_size=4,
+              affinity_pages=2, retry_seed=7, retry_backoff_base_s=0.01)
+    kw.update(coord_overrides)
+    coord = Coordinator(CoordinatorConfig(**kw))
+    await coord.start()
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    return coord, workers
+
+
+async def stop_fleet(coord, workers):
+    await coord.stop()
+    for w in workers.values():
+        try:
+            await w.stop()
+        except Exception:
+            pass
+
+
+async def test_background_stage_never_blocks_dispatch():
+    """While a 0.6 s stage is in flight, requests keep completing at
+    serving latency — a stage that displaced dispatch (ran on the engine
+    executor or inside a pump step) would stall one request by the full
+    stage cost. The overlap is then read off the swap receipt."""
+    coord, workers = await start_fleet(1)
+    try:
+        await coord.deploy_model(
+            fake_cfg(name="ma", step_latency_s=0.005),
+            register_shards=False)
+        staged = await coord.stage_model(
+            fake_cfg(name="mb", vocab_size=VOCAB_B, load_sleep_s=0.6))
+        assert staged == 1
+        lat = []
+        deadline = time.perf_counter() + 0.6
+        i = 0
+        while time.perf_counter() < deadline:
+            p = [3, 1, 4, 100 + i]
+            t0 = time.perf_counter()
+            r = await coord.submit("ma", prompt=p, max_new_tokens=6,
+                                   no_cache=True)
+            lat.append(time.perf_counter() - t0)
+            assert r["tokens"] == expected_tokens(p, 6)
+            i += 1
+        assert len(lat) >= 5, "dispatch starved during the stage window"
+        assert max(lat) < 0.3, \
+            f"a request stalled {max(lat):.3f}s while staging (the stage " \
+            f"displaced dispatch)"
+        swaps = await coord.swap_model("mb", probe=golden_probe(VOCAB_B))
+        assert swaps[0]["overlap_steps"] > 0, \
+            "stage overlapped zero serving steps"
+        m = await coord.router.client_for("w0").metrics()
+        assert m["stage_overlap_steps"] > 0
+        assert set(m["models"]) == {"ma", "mb"}
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_swap_probe_reject_over_rpc_keeps_serving():
+    """A bad staged artifact (vocab 991: the probe's greedy tokens
+    diverge) must be rejected at swap over RPC; the resident model keeps
+    serving token-exact and the reject is counted."""
+    coord, workers = await start_fleet(1)
+    try:
+        await coord.deploy_model(fake_cfg(name="ma"),
+                                 register_shards=False)
+        await coord.stage_model(fake_cfg(name="mb", vocab_size=991))
+        with pytest.raises(Exception, match="probe FAILED"):
+            await coord.swap_model("mb", probe=golden_probe(VOCAB_B))
+        m = await coord.router.client_for("w0").metrics()
+        assert m["swap_probe_rejects"] == 1
+        assert set(m["models"]) == {"ma"}
+        p = [9, 8, 7]
+        r = await coord.submit("ma", prompt=p, max_new_tokens=6,
+                               no_cache=True)
+        assert r["tokens"] == expected_tokens(p, 6)
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_model_qualified_affinity_and_isolation():
+    """Two models on one fleet: affinity keys are model-qualified (the
+    same prompt under ma and mb binds under DIFFERENT keys), per-model
+    LB counters account every pick, and each model's tokens follow its
+    own vocab chain — any cross-model KV or routing mix-up diverges."""
+    coord, workers = await start_fleet(2)
+    try:
+        await coord.deploy_model(fake_cfg(name="ma"),
+                                 register_shards=False)
+        await coord.deploy_model(fake_cfg(name="mb", vocab_size=VOCAB_B),
+                                 register_shards=False)
+        prefix = [5, 5, 5, 5]          # one full affinity page
+        for i in range(8):
+            p = prefix + [50 + i]
+            ra = await coord.submit("ma", prompt=p, max_new_tokens=6,
+                                    no_cache=True)
+            rb = await coord.submit("mb", prompt=p, max_new_tokens=6,
+                                    no_cache=True)
+            assert ra["tokens"] == expected_tokens(p, 6, vocab=VOCAB_A)
+            assert rb["tokens"] == expected_tokens(p, 6, vocab=VOCAB_B)
+            assert ra["tokens"] != rb["tokens"]
+        models_of_keys = {k.split(":", 1)[0]
+                          for k in coord.lb._affinity}
+        assert models_of_keys == {"ma", "mb"}, \
+            f"affinity keys not model-qualified: {models_of_keys}"
+        per_model = coord.lb.get_all_stats()["affinity_models"]
+        for name in ("ma", "mb"):
+            rec = per_model[name]
+            assert rec["hits"] == 7 and rec["misses"] == 1, rec
+    finally:
+        await stop_fleet(coord, workers)
+
+
+async def test_respawn_reloads_full_resident_set():
+    """Supervisor respawn of a multi-model worker must reload EVERY
+    catalog model, not just one — the replacement rejoins able to serve
+    both chains token-exact."""
+    coord, workers = await start_fleet(
+        2,
+        health=HealthConfig(check_interval=0.05, check_timeout=0.5,
+                            max_consecutive_failures=2),
+        supervisor_interval_s=0.05, supervisor_backoff_base_s=0.02,
+        supervisor_backoff_max_s=0.1)
+    spawned = []
+
+    async def restart_hook(worker_id, info):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=worker_id))
+        host, port = await w.start()
+        spawned.append(w)
+        return host, port
+
+    coord.start_supervisor(restart_hook)
+    try:
+        await coord.deploy_model(fake_cfg(name="ma"),
+                                 register_shards=False)
+        await coord.deploy_model(fake_cfg(name="mb", vocab_size=VOCAB_B),
+                                 register_shards=False)
+        await workers.pop("w1").stop()
+        for _ in range(100):
+            if coord.get_stats()["supervisor_respawns"] >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert coord.get_stats()["supervisor_respawns"] >= 1
+        res = await coord.router.client_for("w1").resident_models()
+        assert set(res["resident"]) == {"ma", "mb"}, \
+            f"respawn reloaded {res['resident']}, catalog is [ma, mb]"
+        assert "w1" in coord.lb.workers_with_model("mb")
+        p = [2, 4, 6]
+        for name, vocab in (("ma", VOCAB_A), ("mb", VOCAB_B)):
+            r = await coord.submit(name, prompt=p, max_new_tokens=6,
+                                   no_cache=True)
+            assert r["tokens"] == expected_tokens(p, 6, vocab=vocab)
+    finally:
+        await stop_fleet(coord, workers)
+        for w in spawned:
+            try:
+                await w.stop()
+            except Exception:
+                pass
