@@ -235,6 +235,40 @@ class EngineConfig:
                                        # kept for the Perfetto export; the
                                        # oldest fall off. 0 disables
                                        # recording entirely.
+    # ---- bubble-scheduled async speculation (ISSUE 15 / ROADMAP 5) ----
+    spec_async: bool = False           # drafter subsystem (engine/
+                                       # spec_async.py): a small draft
+                                       # model decodes short chunks for
+                                       # streaming-flagged slots inside
+                                       # the measured host-gap window;
+                                       # drafted tokens ride the NEXT
+                                       # step as extra verify columns.
+                                       # Greedy output stays token-for-
+                                       # token identical to spec off
+                                       # (rejection sampling, engine/
+                                       # spec_accept.py). Off by default.
+    spec_draft_model: str = ""         # draft source: "layers:N" builds a
+                                       # truncated self-draft from the
+                                       # target's first N blocks (engine.
+                                       # speculative.truncated_draft — the
+                                       # zero-artifact default; "" means
+                                       # layers:2). Engines constructed
+                                       # directly may pass an explicit
+                                       # draft_spec/draft_params instead.
+    spec_max_draft: int = 4            # draft tokens proposed per round =
+                                       # extra verify columns per drafted
+                                       # slot. Static in the verify
+                                       # program (one program per
+                                       # use_stops variant — the
+                                       # compile-count guard audits this).
+    spec_bubble_floor_s: float = 5e-4  # auto-idle threshold: the drafter
+                                       # skips its round when the live
+                                       # per-step host-gap estimate (fed
+                                       # from obs.timeline.busy_gap_split,
+                                       # falling back to the engine's
+                                       # dispatch/gap accumulators) is
+                                       # below this — speculation costs
+                                       # ~zero goodput at saturation.
 
 
 def validate_prefill_compose(prefill_chunk: int, sp: int = 1) -> None:

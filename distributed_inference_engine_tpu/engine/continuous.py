@@ -54,6 +54,7 @@ from ..models.base import (
 )
 from ..ops.sampling import (
     SamplingParams,
+    masked_sampling_probs,
     sample_tokens,
     sample_tokens_with_logprobs,
 )
@@ -62,6 +63,7 @@ from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
 from .paged_kv import PagedKVCache, page_chain_hashes
+from .spec_accept import rejection_accept
 from .types import (
     EngineOverloadedError,
     GenerationRequest,
@@ -221,6 +223,10 @@ class ContinuousEngine:
                             # admitting traffic (mismatch raises
                             # ArtifactCorruptError, never serves wrong
                             # numerics)
+        draft_spec=None,          # async speculation (cfg.spec_async):
+        draft_params=None,        # explicit drafter pair; None builds
+                            # one from cfg.spec_draft_model
+                            # (engine/spec_async.py resolve_draft)
     ) -> None:
         self.config = config or EngineConfig()
         cfg = self.config
@@ -743,6 +749,93 @@ class ContinuousEngine:
             return ((kp, vp, new_len, last, active, produced), packed,
                     pf_first)
 
+        spec_k = int(getattr(cfg, "spec_max_draft", 4) or 4)
+
+        @partial(jax.jit, static_argnames=("use_stops",),
+                 donate_argnums=(1, 2, 3, 4, 5, 6))
+        def _verify_chunk(params, kp, vp, lengths, last_tokens, active,
+                          produced, page_table, cap, max_new, sampling,
+                          eos_ids, stop_mat, firsts, drafts, q_probs,
+                          n_drafts, key, use_stops: bool = False):
+            """One VERIFY step (ISSUE 15, async speculation): every slot
+            runs through one ragged ``forward_mixed_step`` dispatch —
+            drafted slots as ``1 + n_drafts`` query columns
+            ``[last, d_0..d_{m-1}]`` at positions ``[L, L+m]``, plain
+            slots as the usual q=1 decode row (``n_drafts == 0``),
+            inactive slots inert (q=0). The target distributions at all
+            window positions come out of the ONE forward; acceptance is
+            the shared rejection rule (``engine.spec_accept``), so the
+            emitted run is drafts[:n_acc] then one target-sampled
+            token — greedy rows are token-for-token the plain engine's
+            chain, and plain rows reduce to exactly the non-speculative
+            sample (zeroed q makes the residual equal p).
+
+            Emission replays ``_decode_chunk``'s per-step ``advance``
+            over the ``spec_max_draft + 1`` window positions so
+            eos/budget/cap/stop cuts land with identical ordering; the
+            packed layout matches ``_process_packed`` at that n_steps
+            with ONE extra trailing row (per-slot ``n_acc``) the
+            speculator reads off the same blocking host read."""
+            kd = spec_k
+            b = lengths.shape[0]
+            tokens = jnp.concatenate([last_tokens[:, None], drafts],
+                                     axis=1)                  # [B, kd+1]
+            ctx = jnp.where(active, lengths, 0)
+            qlens = jnp.where(active, 1 + n_drafts, 0)
+            x, kp, vp = forward_mixed_step(
+                spec_, params, tokens, ctx, qlens, kp, vp, page_table,
+                attn_impl=self.attn_impl, return_hidden_all=True)
+            logits = unembed(spec_, params, x)            # [B, kd+1, V]
+            p_probs = masked_sampling_probs(logits, sampling)
+            greedy = sampling.temperature <= 0.0
+            k_resid, k_bonus = jax.random.split(key)
+            valid = jnp.arange(kd)[None, :] < n_drafts[:, None]
+            qz = jnp.where(valid[:, :, None], q_probs, 0.0)
+            n_acc, final, _acc = rejection_accept(
+                p_probs, qz, drafts, greedy, k_resid, k_bonus,
+                valid=valid)
+            bidx = jnp.arange(b)
+            cand = jnp.concatenate(
+                [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            cand = cand.at[bidx, n_acc].set(final)        # [B, kd+1]
+            # untempered logprob at each emitted position — the same
+            # convention as sample_tokens_with_logprobs
+            lp_all = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                        axis=-1)
+            lp_cand = jnp.take_along_axis(
+                lp_all, cand[:, :, None], axis=-1)[..., 0]
+            in_run = jnp.arange(kd + 1)[:, None] <= n_acc[None, :]
+
+            def emit(carry, inp):
+                lengths, last, active, produced = carry
+                tok_j, lp_j, run_j = inp
+                em = active & run_j
+                produced = produced + em.astype(jnp.int32)
+                hit_eos = (tok_j == eos_ids) & (eos_ids >= 0)
+                new_len = lengths + em.astype(jnp.int32)
+                done = (hit_eos | (produced >= max_new)
+                        | (new_len >= cap))
+                if use_stops:
+                    done = done | ((tok_j[:, None] == stop_mat)
+                                   & (stop_mat >= 0)).any(axis=-1)
+                # unlike _decode_chunk, a row can be active but PAST its
+                # accepted run (run_j False): its stale done conditions
+                # must not retire it, hence the em mask
+                active = active & ~(em & done)
+                last = jnp.where(em, tok_j, last)
+                emitted = jnp.where(em, tok_j, -1)
+                lp_o = jnp.where(em, lp_j, 0.0)
+                return (new_len, last, active, produced), (emitted, lp_o)
+
+            (lengths, last, active, produced), (toks, lps) = jax.lax.scan(
+                emit, (lengths, last_tokens, active, produced),
+                (cand.T, lp_cand.T, in_run))
+            packed = jnp.concatenate(
+                [toks, jax.lax.bitcast_convert_type(lps, jnp.int32),
+                 active[None].astype(jnp.int32), lengths[None], firsts,
+                 n_acc[None]], axis=0)
+            return (kp, vp, lengths, last, active, produced), packed
+
         @partial(jax.jit, donate_argnums=tuple(range(11)))
         def _install(lengths, last, active, produced, max_new, eos,
                      temps, top_k, top_p, min_p, stops, slots, vals):
@@ -814,6 +907,7 @@ class ContinuousEngine:
         self._prefill_suffix = _prefill_suffix
         self._decode_chunk = _decode_chunk
         self._mixed_chunk = _mixed_chunk if self._mixed else None
+        self._verify_chunk = _verify_chunk
         # mixed-step chunk buckets: each prefill row pads its suffix to one
         # of these (the ragged kernel's max_q); short tails reuse the
         # smaller prefill buckets instead of always padding to the full
@@ -867,8 +961,10 @@ class ContinuousEngine:
         # after each chunk/mixed dispatch, while the device is busy. The
         # serving pump wires its inbox drain (batch formation) here so
         # admission work rides the device step's shadow instead of the
-        # gap between steps. The hook must only enqueue (engine.submit);
-        # it must NOT call step()/install paths.
+        # gap between steps. The hook must only enqueue (engine.submit),
+        # poll the stream ring, or dispatch async draft rounds
+        # (speculator.schedule — enqueue-only device work); it must NOT
+        # call step()/install paths.
         self.overlap_hook: Optional[Any] = None
         # sub-chunk streaming counters (ISSUE 13): ring traffic, the
         # clamp engagements, and firsts-buffer device fetches (the
@@ -880,6 +976,36 @@ class ContinuousEngine:
         self._ring_high_water = 0    # max ring depth observed
         self._stream_clamped_chunks = 0   # chunks shortened for streaming
         self._firsts_fetches = 0     # whole-buffer firsts readbacks
+
+        # ---- bubble-scheduled async speculation (ISSUE 15 / ROADMAP 5)
+        # dispatched-but-unprocessed decode/mixed/verify chunks: while
+        # nonzero the host state lags the device frontier, so the
+        # speculator restricts itself to draft-cache catch-up (proposing
+        # from a stale basis would only be wasted at verify time)
+        self._inflight_chunks = 0
+        self._spec_verify_steps = 0
+        self.speculator = None
+        if bool(getattr(cfg, "spec_async", False)):
+            if self._defer:
+                raise ValueError(
+                    "spec_async requires defer_sync=False: proposals "
+                    "need the live host frontier, which deferral keeps "
+                    "one chunk stale")
+            if self.spec.sliding_window:
+                raise ValueError(
+                    "spec_async does not support sliding-window "
+                    "attention (the ragged verify path rejects it)")
+            from .spec_async import AsyncSpeculator, resolve_draft
+
+            if draft_spec is None or draft_params is None:
+                draft_spec, draft_params = resolve_draft(
+                    self.spec, self.params,
+                    getattr(cfg, "spec_draft_model", ""))
+            self.speculator = AsyncSpeculator(
+                self, draft_spec, draft_params, k=spec_k,
+                bubble_floor_s=float(
+                    getattr(cfg, "spec_bubble_floor_s", 5e-4)),
+                seed=seed)
 
         if self.artifact_manifest is not None and artifact_selfcheck:
             # golden-token self-check BEFORE any traffic: replays the
@@ -1726,6 +1852,7 @@ class ContinuousEngine:
         kp, vp, self._lengths, self._last, self._active, self._produced = \
             carry
         self.kv.swap(kp, vp)
+        self._inflight_chunks += 1
         # the device is busy with the dispatched step: let the serving
         # layer form the next batch in its shadow (ISSUE 5c)
         self._run_overlap_hook()
@@ -2080,11 +2207,19 @@ class ContinuousEngine:
             # processing it would be a no-op, so release its device
             # buffer and _Slot references here instead of holding them
             # across an idle period
+            if self._pending is not None:
+                self._inflight_chunks = max(0, self._inflight_chunks - 1)
             self._pending = None
             self._ring.clear()
             return len(self._prefilling) + len(self._swapped)
         self._steps += 1
         self._occupancy_sum += len(self._slots)   # batch occupancy metric
+        if self.speculator is not None:
+            # step top = the inter-dispatch host gap, the one point
+            # where the host state IS the device frontier
+            # (_inflight_chunks == 0): draft PROPOSALS happen here;
+            # the overlap-hook call mid-flight only catches caches up
+            self.speculator.schedule()
 
         # capacity: grow every active slot toward a full chunk (two chunks
         # under defer_sync: the device may already be n_steps past the
@@ -2093,6 +2228,11 @@ class ContinuousEngine:
         n_steps = self.config.decode_steps_per_call
         lengths_np = self._lengths_host
         ahead = 2 * n_steps if self._defer else n_steps
+        if self.speculator is not None:
+            # a verify window writes KV at [L, L + spec_max_draft + 1):
+            # granting less would scatter through stale page-table
+            # entries into OTHER slots' pages
+            ahead = max(ahead, self.speculator.k + 1)
         retired: List[int] = []
         for slot in list(self._slots):
             state = self._slots.get(slot)
@@ -2144,6 +2284,17 @@ class ContinuousEngine:
             return (len(self._slots) + len(self._prefilling)
                     + len(self._swapped))
 
+        if self.speculator is not None:
+            ver = self.speculator.take_verifiable()
+            if ver is not None:
+                # pending proposals survive the freshness + capacity
+                # checks: this step verifies them instead of plain
+                # decoding — drafted slots advance up to n_acc + 1
+                # tokens in the one dispatch
+                self._step_verify(*ver)
+                return (len(self._slots) + len(self._prefilling)
+                        + len(self._swapped))
+
         t0 = time.perf_counter()
         cap_list = [min(self.kv.slot_capacity(s), self.max_seq_len)
                     if s in self._slots else 0
@@ -2178,6 +2329,7 @@ class ContinuousEngine:
         )
         kp, vp, self._lengths, self._last, self._active, self._produced = carry
         self.kv.swap(kp, vp)
+        self._inflight_chunks += 1
         # the chunk is in flight: overlap serving-side batch formation
         # with the device step (ISSUE 5c) before the blocking read below
         self._run_overlap_hook()
@@ -2212,6 +2364,44 @@ class ContinuousEngine:
                         rows=len(snapshot), n_steps=n_steps)
         return (len(self._slots) + len(self._prefilling)
                 + len(self._swapped))
+
+    def _step_verify(self, drafts, q_probs, n_drafts, verified) -> None:
+        """Decode step carrying pending draft proposals as extra verify
+        columns (ISSUE 15): one ``_verify_chunk`` dispatch advances
+        drafted slots by their accepted run + one target token and every
+        other slot by one plain token. The packed layout matches
+        ``_process_packed`` at ``n_steps = spec_max_draft + 1``; the
+        trailing ``n_acc`` row rides the same blocking read, so the
+        acceptance metrics cost zero extra syncs."""
+        kd = self.speculator.k
+        t0 = time.perf_counter()
+        cap_list = [min(self.kv.slot_capacity(s), self.max_seq_len)
+                    if s in self._slots else 0
+                    for s in range(self.max_slots)]
+        cap = jnp.asarray(cap_list, jnp.int32)
+        sampling = SamplingParams(self._temps, self._top_k, self._top_p,
+                                  self._min_p)
+        self._rng, kc = jax.random.split(self._rng)
+        self.kv.sync_tiers()
+        carry, packed = self._verify_chunk(
+            self.params, self.kv.k_pages, self.kv.v_pages,
+            self._lengths, self._last, self._active, self._produced,
+            self.kv.page_table, cap, self._max_new, sampling, self._eos,
+            self._stops_dev, self._firsts_dev, drafts, q_probs,
+            jnp.asarray(n_drafts), kc, use_stops=bool(self._stop_slots))
+        kp, vp, self._lengths, self._last, self._active, self._produced \
+            = carry
+        self.kv.swap(kp, vp)
+        self._inflight_chunks += 1
+        self._run_overlap_hook()
+        snapshot = dict(self._slots)
+        entry = _ChunkEntry(packed, kd + 1, snapshot, t0, cap_list, True)
+        self._process_packed(entry)
+        self._spec_verify_steps += 1
+        self.speculator.note_verified(entry, verified)
+        self._tl_record("verify", t0,
+                        program=("verify", kd, bool(self._stop_slots)),
+                        rows=len(snapshot), n_steps=kd + 1)
 
     def poll_stream(self) -> int:
         """Drain ready stream-ring entries' TOKEN halves without blocking
@@ -2333,6 +2523,8 @@ class ContinuousEngine:
         refresh the host cache for free (deferred processing runs a
         chunk behind admissions, so its rows may be stale)."""
         self._harvest_chunk(entry)
+        # counted at dispatch; processed exactly once per entry
+        self._inflight_chunks = max(0, self._inflight_chunks - 1)
         packed_np = entry.host
         n_steps = entry.n_steps
         caps = entry.caps
@@ -2596,6 +2788,19 @@ class ContinuousEngine:
             "stream_ring_depth": self._ring_high_water,
             "stream_clamped_chunks": self._stream_clamped_chunks,
             "firsts_fetches": self._firsts_fetches,
+            # async speculation (ISSUE 15): zeros when the drafter is
+            # off, so the metric family — and the observability drift
+            # catalog rows over it — exist unconditionally
+            **{f"spec_async_{k}": v for k, v in (
+                self.speculator.get_metrics()
+                if self.speculator is not None else {
+                    "drafted_tokens": 0, "accepted_tokens": 0,
+                    "wasted_tokens": 0, "catchup_tokens": 0,
+                    "accept_rate": 0.0, "draft_rounds": 0,
+                    "propose_rounds": 0, "auto_idles": 0,
+                    "bubble_consumed_s": 0.0, "draft_cost_ema_s": 0.0,
+                    "pending": 0}).items()},
+            "spec_async_verify_steps": self._spec_verify_steps,
             "ttft": self.ttft_stats.snapshot(),
             "batch_occupancy": (self._occupancy_sum
                                 / (self._steps * self.max_slots)

@@ -64,6 +64,7 @@ from ..obs.timeline import StepTimeline
 from ..utils.hotpath import hot_path
 from ..utils.tracing import LatencyStats
 from .engine import _next_bucket, _pow2_buckets
+from .spec_accept import draft_sample, rejection_accept
 from .types import (
     GenerationRequest,
     GenerationResult,
@@ -277,10 +278,8 @@ class SpeculativeEngine:
 
             def propose(carry, step_key):
                 dck, dcv, q_logits, pos = carry
-                probs = masked_sampling_probs(q_logits, sampling)
-                d_samp = jax.random.categorical(step_key, jnp.log(
-                    jnp.maximum(probs, 1e-30)), axis=-1)
-                d_tok = jnp.where(greedy[:, 0], q_logits.argmax(-1), d_samp)
+                d_tok, probs = draft_sample(q_logits, sampling, greedy,
+                                            step_key)
                 nxt, dck, dcv = forward_window(
                     spec_d, pd, d_tok[:, None], ones, pos, dck, dcv,
                 )
@@ -301,34 +300,11 @@ class SpeculativeEngine:
             )                                                    # [B, k+1, V]
             p_probs = masked_sampling_probs(t_logits, sampling)
 
-            # --- 4. acceptance
-            p_at_d = jnp.take_along_axis(
-                p_probs[:, :k], drafts[:, :, None], axis=-1)[..., 0]
-            q_at_d = jnp.take_along_axis(
-                q_probs, drafts[:, :, None], axis=-1)[..., 0]
-            u = jax.random.uniform(k_resid, drafts.shape)
-            acc_samp = u * q_at_d < p_at_d
-            acc_greedy = p_probs[:, :k].argmax(-1) == drafts
-            accept = jnp.where(greedy, acc_greedy, acc_samp)     # [B, k]
-            acc_run = jnp.cumprod(accept.astype(jnp.int32), axis=1)
-            n_acc = acc_run.sum(axis=1)                          # [B] 0..k
-
-            # final token: bonus sample from p_k when all accepted, else
-            # resample from the residual at the first rejected position
-            all_acc = n_acc == k
-            pos_r = jnp.minimum(n_acc, k - 1)
-            p_rej = p_probs[bidx, pos_r]                         # [B, V]
-            q_rej = q_probs[bidx, pos_r]
-            resid = jnp.maximum(p_rej - q_rej, 0.0)
-            resid_sum = resid.sum(-1, keepdims=True)
-            # degenerate residual (q covers p): fall back to p
-            resid = jnp.where(resid_sum > 1e-9, resid, p_rej)
-            resid = resid / resid.sum(-1, keepdims=True)
-            p_bonus = p_probs[bidx, jnp.int32(k)]
-            final_dist = jnp.where(all_acc[:, None], p_bonus, resid)
-            f_samp = jax.random.categorical(
-                k_bonus, jnp.log(jnp.maximum(final_dist, 1e-30)), axis=-1)
-            final = jnp.where(greedy[:, 0], final_dist.argmax(-1), f_samp)
+            # --- 4. acceptance — the shared rejection-sampling rule
+            # (engine/spec_accept.py, bit-parity pinned by the r5 parity
+            # test); the async verify chunk accepts with the same code
+            n_acc, final, _accept = rejection_accept(
+                p_probs, q_probs, drafts, greedy, k_resid, k_bonus)
 
             # --- 5. bookkeeping (inactive slots frozen)
             was_active = active

@@ -111,6 +111,12 @@ def engine_from_config(cfg):
                     cfg.metadata.get("stream_chunk_tokens", 0)),
                 stream_dispatch_overhead_s=float(
                     cfg.metadata.get("stream_dispatch_overhead_s", 0.0)),
+                spec_async=bool(cfg.metadata.get("spec_async", False)),
+                spec_max_draft=int(cfg.metadata.get("spec_max_draft", 4)),
+                spec_accept_rate=float(
+                    cfg.metadata.get("spec_accept_rate", 0.7)),
+                spec_bubble_floor_s=float(
+                    cfg.metadata.get("spec_bubble_floor_s", 0.0)),
             )
         return FakeEngine(
             latency_s=float(cfg.metadata.get("latency_s", 0.0)),
@@ -169,7 +175,8 @@ def engine_from_config(cfg):
               "prefix_cache", "prefill_chunk", "decode_mode",
               "max_waiting", "queue_deadline_s",
               "kv_offload", "kv_offload_bytes", "mixed_step_tokens",
-              "stream_chunk_steps"):
+              "stream_chunk_steps", "spec_async", "spec_draft_model",
+              "spec_max_draft", "spec_bubble_floor_s"):
         if k in cfg.metadata:
             setattr(ecfg, k, cfg.metadata[k])
 

@@ -601,6 +601,7 @@ def forward_mixed_step(
     page_table: jnp.ndarray,  # [R, MP] int32
     *,
     attn_impl: str = "xla",
+    return_hidden_all: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """ONE ragged mixed-batch step: decode rows (one token) and prefill-
     chunk rows (many tokens) share a single forward against the paged
@@ -614,6 +615,13 @@ def forward_mixed_step(
     hidden at each row's LAST valid token, i.e. the next-token state —
     plus the updated pools). Rows with ``q_lens == 0`` return garbage
     hidden; callers mask them (the engine's ``active`` lattice).
+
+    ``return_hidden_all=True`` returns the WHOLE hidden lattice
+    [R, Qm, D] instead of the last-position gather — the async
+    speculative verify chunk (``engine/spec_async.py``) scores every
+    draft column's next-token distribution from one dispatch, so it
+    needs all positions, not just the frontier. Padding positions carry
+    garbage hidden; callers mask by ``q_lens`` exactly as for rows.
 
     The pallas path streams context pages per layer inside the kernel
     (stacked-pool ``layer=l`` calls, flat [L*N, P, fused] carry); the xla
@@ -706,6 +714,8 @@ def forward_mixed_step(
         k_pages = kp_flat.reshape(L, n, p, fused)
         v_pages = vp_flat.reshape(L, n, p, fused)
 
+    if return_hidden_all:
+        return x, k_pages, v_pages                             # [R, Qm, D]
     last = x[jnp.arange(b), jnp.maximum(q_lens - 1, 0)]        # [R, D]
     return last, k_pages, v_pages
 

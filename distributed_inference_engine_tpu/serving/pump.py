@@ -58,6 +58,7 @@ class EnginePump:
             engine.config.mixed_step_tokens = int(mixed_step_tokens)
         self._overlap_admitted = 0
         self._stream_frames_polled = 0
+        self._spec_rounds = 0
         # sub-chunk streaming (ISSUE 13): harvest ready token-ring
         # entries inside the measured host bubble. Engine-thread-only by
         # the same argument as the overlap hook below.
@@ -78,6 +79,16 @@ class EnginePump:
                 # streaming consumers see its tokens one chunk early
                 if self._poll_stream is not None:
                     self._stream_frames_polled += self._poll_stream()
+                # async speculation (ISSUE 15): the drafter rides the
+                # SAME bubble, strictly after the stream poll — tokens
+                # already computed always beat tokens merely predicted,
+                # and the poll commits state the draft catch-up reads.
+                # Mid-flight the speculator only catches its caches up
+                # (an async dispatch, no host sync), so a draft overrun
+                # queues behind the next chunk rather than delaying it.
+                spec = getattr(self.engine, "speculator", None)
+                if spec is not None:
+                    self._spec_rounds += spec.schedule()
 
             engine.overlap_hook = _overlap
         # (request, optional handoff, optional stream cb, future, loop)
@@ -318,5 +329,8 @@ class EnginePump:
             # streamed frames delivered by host-bubble ring polls rather
             # than the deferred flush (ISSUE 13)
             "stream_frames_polled": self._stream_frames_polled,
+            # draft rounds dispatched from the overlap hook's bubble
+            # share (ISSUE 15; step-top propose rounds are the engine's)
+            "spec_overlap_rounds": self._spec_rounds,
             "engine": self.engine.get_metrics(),
         }
