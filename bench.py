@@ -471,10 +471,18 @@ def dump_obs(engine, result_rows, label, pump=None) -> None:
         with open(os.path.join(out_dir, f"bench_metrics_{label}.prom"),
                   "w") as f:
             f.write(reg.render())
+        # only terminal traces are dumped: a row with no finish_reason is
+        # a request that never completed (cancelled mid-run / in flight at
+        # teardown) and its latency fields are garbage — skipping beats
+        # poisoning downstream percentile tooling with partial marks
+        terminal = [r for r in result_rows if r.get("finish_reason")]
+        skipped = len(result_rows) - len(terminal)
         with open(os.path.join(out_dir, f"bench_traces_{label}.jsonl"),
                   "w") as f:
-            for row in result_rows:
+            for row in terminal:
                 f.write(json.dumps(row) + "\n")
+        if skipped:
+            log(f"obs dump: skipped {skipped} non-terminal trace(s)")
         tl = getattr(engine, "timeline", None)
         if tl is not None and len(tl):
             tl.dump(os.path.join(out_dir, f"bench_timeline_{label}.json"))

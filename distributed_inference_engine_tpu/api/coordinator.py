@@ -55,6 +55,9 @@ from ..engine.types import (
     GenerationResult,
 )
 from ..obs import collectors as obs_collectors
+from ..obs import clocksync as obs_clocksync
+from ..obs import postmortem as obs_postmortem
+from ..obs.events import EventLog
 from ..obs.registry import MetricsRegistry
 from ..serving.batcher import PAD_INPUT, Batcher
 from ..serving.cache import ResponseCache
@@ -119,6 +122,14 @@ class CoordinatorConfig:
     supervisor_crashloop_threshold: int = 3
     supervisor_crashloop_window_s: float = 60.0
     supervisor_load_timeout_s: float = 600.0
+    # flight recorder (ISSUE 19): typed event ring capacity, clock-sync
+    # ping samples for the fleet-trace merge, and the post-mortem bundle
+    # destination ("" disables dumping — supervision paths fire bundles
+    # best-effort only when a directory is configured)
+    event_ring_capacity: int = 2048
+    clocksync_samples: int = 5
+    events_timeout_s: float = 2.0         # per-worker events/ping RPC
+    postmortem_dir: str = ""
 
     @classmethod
     def from_config(cls, cfg: Config) -> "CoordinatorConfig":
@@ -146,6 +157,7 @@ class _SupervisedWorker:
     attempts: int = 0            # consecutive failures (backoff exponent)
     next_attempt: float = 0.0    # monotonic gate for the next try
     respawning: bool = False     # an attempt is in flight this sweep
+    death_dumped: bool = False   # post-mortem fired for this incident
 
 
 class Coordinator:
@@ -248,6 +260,26 @@ class Coordinator:
         self._worker_metrics: Dict[str, Dict[str, Any]] = {}
         self._recent_traces: "OrderedDict[str, RequestTrace]" = OrderedDict()
         self._recent_traces_cap = 256
+        # -- flight recorder (ISSUE 19): this process's typed event ring,
+        # the collection cache of every worker's last-fetched ring (the
+        # post-mortem source for DEAD workers), per-worker clock offsets
+        # for the fleet-trace merge, and which worker served each recent
+        # trace (so remove_worker can prune half-open traces)
+        self.events = EventLog("coordinator",
+                               capacity=self.config.event_ring_capacity)
+        self._worker_rings: Dict[str, Dict[str, Any]] = {}
+        self._clock_offsets: Dict[str, Dict[str, float]] = {}
+        self._trace_worker: Dict[str, str] = {}
+        self._postmortem_tasks: set = set()
+        self._postmortems_written = 0
+        self._last_scrape_t: Optional[float] = None
+        self._scrape_count = 0
+        # chaos harnesses share their FaultPlan here so bundles carry the
+        # authoritative injected-fault ledger
+        self.fault_plan = None
+        # breaker transitions become typed events (the LB itself stays
+        # obs-agnostic — it just reports state flips)
+        self.lb.on_transition = self._on_breaker_transition
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -267,6 +299,15 @@ class Coordinator:
             return
         self._running = False
         await self.stop_supervisor()
+        if self._postmortem_tasks:
+            # let in-flight evidence dumps land (bounded), then cut them
+            done, pending = await asyncio.wait(
+                list(self._postmortem_tasks), timeout=5.0)
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            self._postmortem_tasks.clear()
         if self._fabric_snapshot_tasks:
             for t in list(self._fabric_snapshot_tasks):
                 t.cancel()
@@ -293,7 +334,31 @@ class Coordinator:
         graceful exit use ``drain_worker``."""
         a = self.router.unregister_worker(worker_id)
         b = self.lb.unregister_worker(worker_id)
+        # a departed worker's half-open traces will never gain their
+        # terminal mark — prune them so the LRU holds finished evidence,
+        # not ghosts (ISSUE 19 satellite). Its last-collected event ring
+        # stays in _worker_rings: that cache IS the post-mortem source.
+        self._prune_traces_for_worker(worker_id)
         return a or b
+
+    def _prune_traces_for_worker(self, worker_id: str) -> None:
+        """Drop recent traces bound to ``worker_id`` that never reached a
+        terminal mark (``done``) — they are half-open spans that would
+        otherwise sit in the LRU until capacity evicts them."""
+        stale = [rid for rid, wid in self._trace_worker.items()
+                 if wid == worker_id
+                 and rid in self._recent_traces
+                 and "done" not in self._recent_traces[rid].marks]
+        for rid in stale:
+            self._recent_traces.pop(rid, None)
+            self._trace_worker.pop(rid, None)
+
+    def _on_breaker_transition(self, worker_id: str, state: str) -> None:
+        """LB circuit-breaker flips, recorded as typed events."""
+        etype = {"open": "breaker.open", "half_open": "breaker.half_open",
+                 "closed": "breaker.close"}.get(state)
+        if etype is not None:
+            self.events.emit(etype, worker_id=worker_id)
 
     async def drain_worker(self, worker_id: str,
                            timeout_s: Optional[float] = None,
@@ -311,6 +376,7 @@ class Coordinator:
         # KV fabric: hand the retiree's hot prefixes off BEFORE quarantine
         # (quarantine invalidates its bindings — after that the affinity
         # table no longer remembers what this worker was serving)
+        self.events.emit("drain.begin", worker_id=worker_id)
         handed_off = await self._fabric_drain_handoff(worker_id)
         self.lb.quarantine(worker_id)
         client = (self.router.client_for(worker_id)
@@ -321,6 +387,7 @@ class Coordinator:
             summary = dict(summary or {})
             summary["kv_fabric_handoff"] = handed_off
         self._drains += 1
+        self.events.emit("drain.done", worker_id=worker_id)
         if remove:
             self.remove_worker(worker_id)
         return summary
@@ -347,6 +414,8 @@ class Coordinator:
         if shed is None:
             return
         self._admission_sheds += 1
+        self.events.emit("admission.shed", request_id=request_id,
+                         reason=shed["reason"])
         raise EngineOverloadedError(
             f"request {request_id} shed at admission: fleet at max size "
             f"and SLO-violating; retry after {shed['retry_after_s']:.2f}s",
@@ -418,6 +487,12 @@ class Coordinator:
             if wid in self._degraded:
                 continue
             st = self._supervised.setdefault(wid, _SupervisedWorker())
+            if not st.death_dumped:
+                # first sweep that sees this incident: capture the
+                # evidence while the survivors still hold it (the dead
+                # worker's ring comes from the collection cache)
+                st.death_dumped = True
+                self._fire_postmortem("worker_death", dead_workers=(wid,))
             if st.respawning or now < st.next_attempt:
                 continue
             window = self.config.supervisor_crashloop_window_s
@@ -430,6 +505,7 @@ class Coordinator:
                 await self._respawn_worker(wid, info)
                 st.failures.clear()
                 st.attempts = 0
+                st.death_dumped = False   # next death is a new incident
             except Exception as e:
                 t = time.monotonic()
                 st.failures.append(t)
@@ -457,6 +533,7 @@ class Coordinator:
             raise RuntimeError("supervisor armed without a restart hook")
         logger.warning("supervisor: worker %s is unhealthy — respawning",
                        worker_id)
+        self.events.emit("respawn.begin", worker_id=worker_id)
         host_port = await self._restart_hook(worker_id, info)
         if not host_port:
             raise RuntimeError(
@@ -493,6 +570,7 @@ class Coordinator:
         # trial probe — success closes the circuit, failure re-opens it
         self.lb.enter_half_open(worker_id)
         self._supervisor_respawns += 1
+        self.events.emit("respawn.done", worker_id=worker_id)
         logger.warning("supervisor: respawned %s at %s:%s (LB half-open)",
                        worker_id, host, port)
 
@@ -501,6 +579,8 @@ class Coordinator:
             return
         self._degraded.add(worker_id)
         self._supervisor_crashloop_opens += 1
+        self.events.emit("crashloop.open", worker_id=worker_id)
+        self._fire_postmortem("crashloop_open", dead_workers=(worker_id,))
         failed = 0
         for name, mcfg in self._model_configs.items():
             for s in self.registry.all_shards(name, mcfg.version):
@@ -1234,6 +1314,10 @@ class Coordinator:
                 self._dispatch_retries += 1
                 if delivered:
                     self._stream_resumes += 1
+                    self.events.emit("dispatch.failover",
+                                     request_id=request_id,
+                                     from_worker=worker_id, to_worker=alt,
+                                     prefix_tokens=len(delivered))
                     logger.warning(
                         "stream to %s died after %d tokens (%s) — resuming "
                         "on %s with prefix replay", worker_id,
@@ -1323,6 +1407,7 @@ class Coordinator:
         out["streamed"] = True
         out["metadata"]["worker_id"] = worker_id
         self._merge_worker_trace({"trace": trace}, out)
+        self._bind_trace_worker(trace.request_id, worker_id)
         self._remember_trace(trace)
         out["trace"] = trace.to_dict()
         if tokenizer is not None:
@@ -1514,6 +1599,13 @@ class Coordinator:
         # reflects the dispatch that actually produced the result)
         for inp, out in zip(reals, results):
             self._merge_worker_trace(inp, out)
+            # remember which worker served each trace so remove_worker can
+            # prune the half-open ones bound to a departed worker
+            if isinstance(inp, dict) and isinstance(out, dict):
+                tr = inp.get("trace")
+                wid = out.get("metadata", {}).get("worker_id")
+                if isinstance(tr, RequestTrace) and wid:
+                    self._bind_trace_worker(tr.request_id, str(wid))
         return results  # aligned with the real inputs, pads dropped
 
     def _retry_backoff_s(self, attempt: int) -> float:
@@ -1595,6 +1687,8 @@ class Coordinator:
                             self.lb.bind_affinity(akey, alt)
             attempt += 1
             self._dispatch_retries += 1
+            self.events.emit("dispatch.retry", from_worker=wid,
+                             to_worker=alt, attempt=attempt)
             delay = self._retry_backoff_s(attempt - 1)
             logger.warning(
                 "dispatch to %s failed (%s: %s) — retry %d/%d on %s in "
@@ -1920,13 +2014,185 @@ class Coordinator:
         self._recent_traces[trace.request_id] = trace
         self._recent_traces.move_to_end(trace.request_id)
         while len(self._recent_traces) > self._recent_traces_cap:
-            self._recent_traces.popitem(last=False)
+            rid, _ = self._recent_traces.popitem(last=False)
+            self._trace_worker.pop(rid, None)
+
+    def _bind_trace_worker(self, request_id: str, worker_id: str) -> None:
+        """Record which worker served a trace (bounded alongside the
+        trace LRU — orphans from never-remembered traces age out here)."""
+        self._trace_worker[request_id] = worker_id
+        while len(self._trace_worker) > 2 * self._recent_traces_cap:
+            self._trace_worker.pop(next(iter(self._trace_worker)))
 
     def get_trace(self, request_id: str) -> Optional[Dict[str, Any]]:
         """The recorded trace of a recent request (coordinator marks plus
         anchored ``worker.*`` spans), or ``None`` if it has aged out."""
         tr = self._recent_traces.get(request_id)
         return tr.to_dict() if tr is not None else None
+
+    # -- flight recorder: event collection, clock sync, fleet trace,
+    # post-mortem bundles (ISSUE 19) ---------------------------------------
+
+    def _any_client(self, worker_id: str) -> WorkerClient:
+        return (self.router.client_for(worker_id)
+                if worker_id in self.router.workers
+                else self.lb.client_for(worker_id))
+
+    def _fleet_ids(self) -> List[str]:
+        return sorted(set(self.router.workers) | set(self.lb.workers))
+
+    async def collect_events(self,
+                             timeout_s: Optional[float] = None,
+                             ) -> Dict[str, Dict[str, Any]]:
+        """Pull every live worker's event ring (the ``events`` RPC verb)
+        into the collection cache. Best-effort per worker: an unreachable
+        worker keeps its LAST collected ring — which is exactly what a
+        post-mortem needs when that worker is dead."""
+        if timeout_s is None:
+            timeout_s = self.config.events_timeout_s
+
+        async def fetch(wid: str):
+            try:
+                return wid, await self._any_client(wid).call(
+                    "events", timeout=timeout_s)
+            # graftlint: ok[swallowed-transport-error] best-effort collection — a dead worker keeps its cached ring, which IS the post-mortem source
+            except Exception:
+                return wid, None
+
+        fetched = await asyncio.gather(*(fetch(w) for w in self._fleet_ids()))
+        for wid, snap in fetched:
+            if isinstance(snap, dict):
+                self._worker_rings[wid] = snap
+        return dict(self._worker_rings)
+
+    async def estimate_offsets(self, samples: Optional[int] = None,
+                               ) -> Dict[str, Dict[str, float]]:
+        """Refresh per-worker clock offsets (ping midpoint method,
+        ``obs/clocksync.py``). Unreachable workers keep their last
+        estimate — good enough to place a dead worker's cached ring on
+        the fleet timeline."""
+        if samples is None:
+            samples = self.config.clocksync_samples
+        timeout_s = self.config.events_timeout_s
+
+        async def probe(wid: str):
+            try:
+                client = self._any_client(wid)
+                est = await obs_clocksync.estimate_offset(
+                    lambda: client.call("ping", timeout=timeout_s),
+                    samples=samples)
+                return wid, est
+            # graftlint: ok[swallowed-transport-error] best-effort probe — a dead worker keeps its last offset estimate
+            except Exception:
+                return wid, None
+
+        probed = await asyncio.gather(*(probe(w) for w in self._fleet_ids()))
+        for wid, est in probed:
+            if isinstance(est, dict) and est.get("samples"):
+                self._clock_offsets[wid] = est
+        return dict(self._clock_offsets)
+
+    def _coordinator_track(self) -> Dict[str, Any]:
+        spans: List[Dict[str, Any]] = []
+        for rid, tr in self._recent_traces.items():
+            spans.extend(obs_clocksync.spans_from_trace_marks(tr.marks, rid))
+        return {"name": "coordinator", "offset_s": 0.0, "steps": [],
+                "spans": spans, "events": self.events.events()}
+
+    def _worker_track(self, wid: str, ring: Dict[str, Any]) -> Dict[str, Any]:
+        steps: List[Dict[str, Any]] = []
+        timelines = ring.get("timelines")
+        if isinstance(timelines, dict):
+            for model, evs in sorted(timelines.items()):
+                for e in evs or ():
+                    args = dict(e.get("args") or {})
+                    args.setdefault("model", model)
+                    steps.append({"name": e["name"], "t": e["t"],
+                                  "dur": e.get("dur"), "args": args})
+        events = (ring.get("ring") or {}).get("events", [])
+        off = self._clock_offsets.get(wid, {}).get("offset_s", 0.0)
+        return {"name": wid, "offset_s": off, "steps": steps,
+                "spans": [], "events": events}
+
+    async def fleet_trace(self, label: str = "fleet",
+                          refresh: bool = True,
+                          include_dead: bool = True) -> Dict[str, Any]:
+        """ONE Perfetto-loadable trace for the whole fleet: coordinator
+        request spans + typed events, and each worker's engine step
+        timelines + event ring, clock-corrected onto the coordinator's
+        axis — a chaos kill → failover → respawn reads end-to-end on a
+        single timeline. ``include_dead`` keeps tracks for workers that
+        only exist in the collection cache (their last-known ring)."""
+        if refresh:
+            await self.estimate_offsets()
+            await self.collect_events()
+        live = set(self._fleet_ids())
+        tracks = [self._coordinator_track()]
+        for wid in sorted(self._worker_rings):
+            if wid not in live and not include_dead:
+                continue
+            tracks.append(self._worker_track(wid, self._worker_rings[wid]))
+        return obs_clocksync.merge_fleet_trace(tracks, label=label)
+
+    async def write_postmortem(self, reason: str,
+                               dead_workers: Sequence[str] = (),
+                               dir_path: Optional[str] = None,
+                               ) -> Optional[str]:
+        """Dump a crash post-mortem bundle (``obs/postmortem.py``) and
+        return its directory, or ``None`` when no destination is
+        configured. Survivor rings are re-collected first; dead workers'
+        rings come from the collection cache — the whole point of
+        collecting periodically is that this cache outlives them."""
+        if dir_path is None:
+            dir_path = self.config.postmortem_dir
+        if not dir_path:
+            return None
+        dead = set(dead_workers)
+        await self.estimate_offsets()
+        await self.collect_events()
+        live = set(self._fleet_ids())
+        dead |= set(self._worker_rings) - live
+        trace = await self.fleet_trace(label=f"postmortem:{reason}",
+                                       refresh=False)
+        rings: Dict[str, Dict[str, Any]] = {
+            "coordinator": self.events.snapshot()}
+        dead_rings: Dict[str, Dict[str, Any]] = {}
+        for wid, ring in self._worker_rings.items():
+            (dead_rings if wid in dead else rings)[wid] = ring
+        ledger = (self.fault_plan.sequence()
+                  if self.fault_plan is not None else None)
+        bundle = obs_postmortem.write_bundle(
+            dir_path, reason,
+            trace=trace,
+            metrics_text=self.obs_registry.render(),
+            event_rings=rings,
+            dead_rings=dead_rings,
+            fault_ledger=ledger,
+            dead_workers=sorted(dead),
+        )
+        self._postmortems_written += 1
+        self.events.emit("postmortem.bundle", reason=reason)
+        logger.warning("post-mortem bundle (%s) written to %s", reason,
+                       bundle)
+        return bundle
+
+    def _fire_postmortem(self, reason: str,
+                         dead_workers: Sequence[str] = ()) -> None:
+        """Best-effort background dump from supervision paths — a failed
+        dump must never take down the control loop."""
+        if not self.config.postmortem_dir:
+            return
+
+        async def run() -> None:
+            try:
+                await self.write_postmortem(reason, dead_workers)
+            # graftlint: ok[swallowed-transport-error] post-mortem dumping is best-effort evidence capture; supervision must keep running
+            except Exception:
+                logger.exception("post-mortem dump (%s) failed", reason)
+
+        t = asyncio.create_task(run())
+        self._postmortem_tasks.add(t)
+        t.add_done_callback(self._postmortem_tasks.discard)
 
     # -- metrics exposition -------------------------------------------------
 
@@ -1944,6 +2210,9 @@ class Coordinator:
                                 if wid in live}
         obs_collectors.clear_worker_labelled(self.obs_registry)
         obs_collectors.apply_coordinator(self.obs_registry, self.get_stats())
+        obs_collectors.apply_event_log(self.obs_registry,
+                                       self.events.get_stats(),
+                                       proc="coordinator")
         for wid, wm in self._worker_metrics.items():
             obs_collectors.apply_worker(self.obs_registry, wm, worker_id=wid)
 
@@ -1953,7 +2222,13 @@ class Coordinator:
 
         Best-effort polls every registered worker's ``metrics`` RPC first
         (short timeout, failures ignored — a dead worker must not fail the
-        scrape; its series simply go stale-then-cleared)."""
+        scrape; its series simply go stale-then-cleared).
+
+        The scrape observes ITSELF (``obs_scrape_seconds`` /
+        ``obs_scrape_ok``): collect+render wall time is recorded AFTER
+        rendering, so it surfaces on the NEXT exposition — the guard
+        that watches ``scrape_ok`` is thereby itself observable."""
+        t_scrape0 = time.perf_counter()
         if refresh_workers:
             wids = list(self.router.workers)
 
@@ -1971,7 +2246,19 @@ class Coordinator:
             fetched = await asyncio.gather(*(fetch(w) for w in wids))
             self._worker_metrics = {wid: wm for wid, wm in fetched
                                     if isinstance(wm, dict)}
-        return self.obs_registry.render()
+        try:
+            text = self.obs_registry.render()
+        except Exception:
+            obs_collectors.record_scrape(
+                self.obs_registry, "coordinator",
+                time.perf_counter() - t_scrape0, ok=False)
+            raise
+        obs_collectors.record_scrape(self.obs_registry, "coordinator",
+                                     time.perf_counter() - t_scrape0,
+                                     ok=True)
+        self._last_scrape_t = time.monotonic()
+        self._scrape_count += 1
+        return text
 
     # -- introspection ------------------------------------------------------
 
@@ -1999,6 +2286,15 @@ class Coordinator:
                 "armed": self._restart_hook is not None,
                 "degraded_workers": sorted(self._degraded),
             },
+            # flight recorder (ISSUE 19): ring pressure, collection-cache
+            # size, bundle count, and how stale the last /metrics scrape is
+            "events": self.events.get_stats(),
+            "collected_rings": len(self._worker_rings),
+            "postmortems_written": self._postmortems_written,
+            "scrapes": self._scrape_count,
+            "last_scrape_age_s": (
+                round(time.monotonic() - self._last_scrape_t, 3)
+                if self._last_scrape_t is not None else -1.0),
             "cache": self.cache.get_stats(),
             "batcher": self.batcher.get_stats(),
             "router": self.router.get_stats(),

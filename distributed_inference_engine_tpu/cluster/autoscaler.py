@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 from ..config import AutoscalerConfig
 from ..engine.types import GenerationRequest
 from ..obs import collectors as obs_collectors
+from ..obs.slo import BurnObjective, BurnRateEngine, violations_from_buckets
 from .load_balancer import BREAKER_OPEN, BREAKER_HALF_OPEN
 
 logger = logging.getLogger(__name__)
@@ -110,6 +111,10 @@ class SLOSnapshot:
     # False when the scrape reached NO managed worker this tick — an
     # all-zero snapshot then means "no information", not "all clear"
     scrape_ok: bool = True
+    # True while the multi-window burn-rate engine has a breach engaged
+    # (always False when ``slo_burn_enabled`` is off — the policy just
+    # ORs it into the breach condition)
+    burn_breach: bool = False
 
 
 @dataclass(frozen=True)
@@ -199,7 +204,7 @@ class AutoscalerPolicy:
             self.guard_holds += 1
             return self._emit(ACTION_HOLD, "guard:no_data", snap, att)
 
-        breach = att < c.scale_up_attainment
+        breach = att < c.scale_up_attainment or snap.burn_breach
         clear = (att >= c.scale_down_attainment
                  and snap.queue_depth
                  <= c.scale_down_queue_frac * c.queue_depth_target)
@@ -307,6 +312,15 @@ class FleetAutoscaler:
         self._running = False
         self._hist_prev: Dict[str, Dict[str, float]] = {}
         self.last_snapshot = SLOSnapshot()
+        # SLO burn-rate engine (obs/slo.py), behind the config flag: fed
+        # the same scrape-window TTFT deltas the attainment signal uses
+        self.burn_engine: Optional[BurnRateEngine] = None
+        if self.cfg.slo_burn_enabled:
+            self.burn_engine = BurnRateEngine(
+                [BurnObjective("ttft", goal=self.cfg.slo_burn_goal)],
+                fast_ticks=self.cfg.slo_burn_fast_ticks,
+                slow_ticks=self.cfg.slo_burn_slow_ticks,
+                threshold=self.cfg.slo_burn_threshold)
         coordinator.obs_registry.add_collector(self._obs_collect)
 
     # -- lifecycle ----------------------------------------------------------
@@ -403,6 +417,20 @@ class FleetAutoscaler:
                 breaker_open += 1
             elif st.breaker_state == BREAKER_HALF_OPEN:
                 half_open += 1
+        burn_breach = False
+        if self.burn_engine is not None and scrape_ok:
+            # one engine tick per GOOD scrape: the window deltas feed the
+            # fast+slow rings; failed scrapes contribute nothing (windows
+            # must not age on absent evidence)
+            bad = violations_from_buckets(
+                ttft_window, n_req, self.cfg.ttft_p95_target_s)
+            transitions = self.burn_engine.observe(
+                {"ttft": (n_req, bad)})
+            burn_breach = self.burn_engine.breached()
+            for tr in transitions:
+                self.coord.events.emit(
+                    "slo.burn_on" if tr["event"] == "burn_on"
+                    else "slo.burn_off", objective=tr["objective"])
         snap = SLOSnapshot(
             ttft_p95_s=percentile_from_buckets(ttft_window, 0.95),
             itl_p95_s=percentile_from_buckets(itl_window, 0.95),
@@ -413,6 +441,7 @@ class FleetAutoscaler:
             half_open=half_open,
             respawning=self.coord.respawns_in_flight(),
             scrape_ok=scrape_ok,
+            burn_breach=burn_breach,
         )
         self.last_snapshot = snap
         return snap
@@ -513,11 +542,18 @@ class FleetAutoscaler:
                 "queue_depth": self.last_snapshot.queue_depth,
                 "window_requests": self.last_snapshot.window_requests,
             },
+            "burn": (self.burn_engine.get_stats()
+                     if self.burn_engine is not None else None),
+            "burn_ledger": (self.burn_engine.ledger()
+                            if self.burn_engine is not None else []),
         }
 
     def _obs_collect(self) -> None:
         obs_collectors.apply_autoscaler(self.coord.obs_registry,
                                         self.get_stats())
+        if self.burn_engine is not None:
+            obs_collectors.apply_slo(self.coord.obs_registry,
+                                     self.burn_engine.get_stats())
 
 
 @dataclass
@@ -639,6 +675,12 @@ class RollingUpgrade:
                 self.stats.rollbacks += 1
                 self.events.append({"worker": wid, "event": "rolled_back",
                                     "restored": restored})
+                # flight recorder: a rollback is a post-mortem-worthy
+                # incident — bundle the fleet's state at the abort point
+                self.coord.events.emit("upgrade.rollback", worker=wid,
+                                       model=self.model, restored=restored)
+                self.coord._fire_postmortem("upgrade_rollback",
+                                            dead_workers=(wid,))
                 return {"completed": False, "aborted_at": wid,
                         "upgraded": self.stats.upgraded,
                         "rolled_back": restored, "events": list(self.events)}

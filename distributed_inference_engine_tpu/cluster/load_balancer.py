@@ -32,7 +32,7 @@ import random
 import time
 from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, Dict, Hashable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
 from ..config import HealthConfig
 from .worker import WorkerClient
@@ -134,6 +134,10 @@ class LoadBalancer:
         self._model_affinity: Dict[str, Dict[str, int]] = {}
         self._resident_models: Dict[str, set] = {}   # worker -> resident
         self._staged_models: Dict[str, set] = {}     # worker -> staging
+        # breaker-transition observer (flight recorder): called as
+        # on_transition(worker_id, new_state) for every CLOSED/HALF_OPEN/
+        # OPEN flip; must be cheap and must not raise (guarded anyway)
+        self.on_transition: Optional[Callable[[str, str], None]] = None
         self._strategies = {
             LoadBalancerStrategy.ROUND_ROBIN: self._round_robin,
             LoadBalancerStrategy.LEAST_CONNECTIONS: self._least_connections,
@@ -225,18 +229,32 @@ class LoadBalancer:
               >= self.health_config.max_consecutive_failures):
             self._open_breaker(s)
 
+    def _notify_transition(self, worker_id: str, state: str) -> None:
+        cb = self.on_transition
+        if cb is None:
+            return
+        try:
+            cb(worker_id, state)
+        # graftlint: ok[swallowed-transport-error] observer hook — telemetry must never break breaker bookkeeping
+        except Exception:
+            logger.exception("lb: on_transition observer failed")
+
     def _record_success(self, s: WorkerStats) -> None:
         s.consecutive_failures = 0
         if s.breaker_state != BREAKER_CLOSED:
             logger.info("lb: circuit for %s closed", s.worker_id)
-        s.breaker_state = BREAKER_CLOSED
+            s.breaker_state = BREAKER_CLOSED
+            self._notify_transition(s.worker_id, BREAKER_CLOSED)
 
     def _open_breaker(self, s: WorkerStats) -> None:
+        was = s.breaker_state
         s.breaker_state = BREAKER_OPEN
         s.breaker_opened_at = time.monotonic()
         s.breaker_opens += 1
         logger.info("lb: circuit for %s opened (%d consecutive failures)",
                     s.worker_id, s.consecutive_failures)
+        if was != BREAKER_OPEN:
+            self._notify_transition(s.worker_id, BREAKER_OPEN)
 
     def quarantine(self, worker_id: str) -> bool:
         """Administratively open a worker's circuit (the drain/remove path):
@@ -259,6 +277,8 @@ class LoadBalancer:
         if s is None:
             return False
         s.consecutive_failures = 0
+        if s.breaker_state != BREAKER_HALF_OPEN:
+            self._notify_transition(worker_id, BREAKER_HALF_OPEN)
         s.breaker_state = BREAKER_HALF_OPEN
         s.breaker_opened_at = time.monotonic()
         return True
@@ -511,6 +531,7 @@ class LoadBalancer:
             if not cooled:
                 return False
             s.breaker_state = BREAKER_HALF_OPEN
+            self._notify_transition(worker_id, BREAKER_HALF_OPEN)
         s.probe_count += 1
         try:
             pong = await self.client_for(worker_id).ping(

@@ -45,6 +45,7 @@ from ..utils.rpc import (
     relay_stream,
 )
 from ..obs import collectors as obs_collectors
+from ..obs.events import EventLog
 from ..obs.registry import OPENMETRICS_CONTENT_TYPE, MetricsRegistry
 from ..utils.tracing import LatencyStats
 
@@ -265,7 +266,13 @@ class WorkerServer(FramedServerMixin):
             "profile": self._rpc_profile,
             "drain": self._rpc_drain,
             "shutdown": self._rpc_shutdown,
+            "events": self._rpc_events,
         }
+        # flight recorder (obs/events.py): bounded typed event ring,
+        # collected on demand over the ``events`` verb and merged into the
+        # coordinator's fleet trace
+        self.events = EventLog(self.worker_id,
+                               capacity=self.config.event_ring_capacity)
         # unified telemetry: this worker's dict metrics (incl. every loaded
         # engine's) mirrored into stable metric families at scrape time,
         # exposed as OpenMetrics text via the metrics_text RPC verb and
@@ -303,10 +310,24 @@ class WorkerServer(FramedServerMixin):
             for sig in (signal.SIGINT, signal.SIGTERM):
                 loop.add_signal_handler(sig, self._shutdown_event.set)
         host, port = self.address
+        if self.fault_plan is not None:
+            # flight recorder: record injections aimed at THIS worker in
+            # its own event ring (the plan is shared fleet-wide)
+            self.fault_plan.subscribe(self._on_injected_fault)
         logger.info("worker %s listening on %s:%d", self.worker_id, host, port)
         return host, port
 
+    def _on_injected_fault(self, fault) -> None:
+        """FaultPlan listener: mirror injections scoped to this worker
+        into the event ring (the plan notifies on every injection)."""
+        if fault.scope == self._fault_scope():
+            self.events.emit("fault.injected", site=fault.site,
+                             verb=fault.verb, kind=fault.kind,
+                             ordinal=fault.ordinal)
+
     async def stop(self) -> None:
+        if self.fault_plan is not None:
+            self.fault_plan.unsubscribe(self._on_injected_fault)
         if self._server is not None:
             self._server.close()
             # persistent connections never exit on their own — close them, or
@@ -385,7 +406,8 @@ class WorkerServer(FramedServerMixin):
             self._pumps[cfg.name] = EnginePump(
                 engine,
                 mixed_step_tokens=(
-                    int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
+                    int(cfg.metadata.get("mixed_step_tokens", 0)) or None),
+                event_log=self.events, model=cfg.name)
 
     def _check_idempotent(self, cfg: ModelConfig) -> bool:
         """True when ``cfg`` is already loaded with a compatible config;
@@ -480,7 +502,8 @@ class WorkerServer(FramedServerMixin):
                 self._pumps[name] = EnginePump(
                     engine,
                     mixed_step_tokens=(
-                        int(cfg.metadata.get("mixed_step_tokens", 0)) or None))
+                        int(cfg.metadata.get("mixed_step_tokens", 0)) or None),
+                    event_log=self.events, model=name)
         return receipt
 
     # -- connection handling (loop + envelope in FramedServerMixin) -----------
@@ -549,7 +572,10 @@ class WorkerServer(FramedServerMixin):
 
     async def _rpc_ping(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._ping_count += 1
+        # "mono": this process's perf_counter — the coordinator's clock-sync
+        # pairs it with its own send/recv stamps (obs/clocksync.py)
         return {"worker_id": self.worker_id, "time": time.time(),
+                "mono": time.perf_counter(),
                 "models": sorted(self.engines),
                 "staged": self.model_manager.staged_names(),
                 "draining": self._draining}
@@ -793,6 +819,8 @@ class WorkerServer(FramedServerMixin):
         if wire is not None:
             self._kv_fabric_exports += 1
             self._kv_fabric_export_bytes += wire_nbytes(wire)
+            self.events.emit("fabric.export", model=name,
+                             pages=len(wire.get("pages", ())) if isinstance(wire, dict) else 0)
         return {"model": name, "wire": wire}
 
     async def _rpc_kv_import(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -818,6 +846,7 @@ class WorkerServer(FramedServerMixin):
                     "rejected": str(exc)}
         self._kv_fabric_imports += 1
         self._kv_fabric_import_bytes += wire_nbytes(wire)
+        self.events.emit("fabric.import", model=name, pages=int(imported))
         return {"model": name, "imported_pages": int(imported)}
 
     async def _rpc_generate_prefilled(self, msg: Dict[str, Any]) -> Dict[str, Any]:
@@ -1077,6 +1106,8 @@ class WorkerServer(FramedServerMixin):
         waits for it, probes it, and installs it."""
         cfg = ModelConfig.from_dict(msg["config"])
         rec = self.stage_model(cfg)
+        if rec is not None:
+            self.events.emit("model.stage", model=cfg.name)
         return {"staging": cfg.name,
                 "already_resident": rec is None}
 
@@ -1091,13 +1122,16 @@ class WorkerServer(FramedServerMixin):
         timeout = msg.get("timeout_s")
         loop = asyncio.get_running_loop()
         try:
-            return await loop.run_in_executor(
+            receipt = await loop.run_in_executor(
                 self._executor,
                 lambda: self.swap_model(
                     name,
                     probe_expected=([int(t) for t in probe]
                                     if probe else None),
                     timeout=float(timeout) if timeout else None))
+            if not receipt.get("already_resident"):
+                self.events.emit("model.swap", model=name)
+            return receipt
         except (ModelProbeError, ModelStageError) as e:
             # typed application errors — the RPC envelope carries them as
             # failures without denting transport-level health
@@ -1123,10 +1157,25 @@ class WorkerServer(FramedServerMixin):
     def _obs_collect(self) -> None:
         obs_collectors.clear_worker_labelled(self.obs_registry)
         obs_collectors.apply_worker(self.obs_registry, self.get_metrics())
+        obs_collectors.apply_event_log(self.obs_registry,
+                                       self.events.get_stats(),
+                                       proc=self.worker_id)
 
     def metrics_text(self) -> str:
-        """This worker's metrics as OpenMetrics exposition text."""
-        return self.obs_registry.render()
+        """This worker's metrics as OpenMetrics exposition text. The
+        render is self-timed (obs_scrape_seconds / obs_scrape_ok) — the
+        sample lands on the NEXT exposition, it can't time itself into
+        its own output."""
+        t0 = time.perf_counter()
+        try:
+            text = self.obs_registry.render()
+        except Exception:
+            obs_collectors.record_scrape(self.obs_registry, self.worker_id,
+                                         time.perf_counter() - t0, ok=False)
+            raise
+        obs_collectors.record_scrape(self.obs_registry, self.worker_id,
+                                     time.perf_counter() - t0, ok=True)
+        return text
 
     async def _rpc_metrics_text(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return {"content_type": OPENMETRICS_CONTENT_TYPE,
@@ -1150,6 +1199,7 @@ class WorkerServer(FramedServerMixin):
         if not self._draining:
             self._draining = True
             self._drain_count += 1
+            self.events.emit("drain.begin")
             logger.info("worker %s draining (timeout %.1fs)",
                         self.worker_id, timeout_s)
         deadline = time.monotonic() + timeout_s
@@ -1171,8 +1221,25 @@ class WorkerServer(FramedServerMixin):
                     t in k for t in ("prefix", "kv", "page", "token",
                                      "request", "waiting", "live"))
             }
+        self.events.emit("drain.done", drained=drained,
+                         in_flight=self._busy)
         return {"worker_id": self.worker_id, "drained": drained,
                 "in_flight": self._busy, "models": summary}
+
+    async def _rpc_events(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Flight-recorder collection verb: this worker's event ring plus
+        every resident continuous engine's step timeline (perf_counter
+        axis), with a fresh ``mono`` stamp so the caller can re-anchor."""
+        timelines: Dict[str, List[Dict[str, Any]]] = {}
+        for name, engine in self.engines.items():
+            tl = getattr(engine, "timeline", None)
+            if tl is not None:
+                timelines[name] = tl.events()
+        return {"worker_id": self.worker_id,
+                "mono": time.perf_counter(),
+                "wall": time.time(),
+                "ring": self.events.snapshot(),
+                "timelines": timelines}
 
     async def _rpc_shutdown(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._shutdown_event.set()
@@ -1249,6 +1316,10 @@ class WorkerClient(FramedRPCClient):
 
     async def ping(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         return await self.call("ping", timeout=timeout)
+
+    async def events(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Flight-recorder collection: event ring + step timelines."""
+        return await self.call("events", timeout=timeout)
 
     async def generate(
         self, model: str, requests: List[GenerationRequest],

@@ -365,6 +365,9 @@ class ServerConfig:
     # ones with in-flight work). 0 = unbounded.
     max_resident_models: int = 0
     resident_bytes: int = 0
+    # flight recorder (obs/events.py): bounded per-process typed event
+    # ring collected over the ``events`` RPC verb
+    event_ring_capacity: int = 2048
 
 
 @dataclass
@@ -405,6 +408,16 @@ class AutoscalerConfig:
     # policy loop cadence and victim tie-break seed
     interval_s: float = 0.5
     seed: int = 0
+    # SLO burn-rate engine (obs/slo.py): when enabled, a multi-window
+    # (fast + slow, tick-counted) error-budget burn evaluation over the
+    # TTFT attainment window feeds the breach signal alongside the
+    # attainment band. Burn = (bad/total) / (1 - goal); a breach needs
+    # BOTH windows at or above the threshold.
+    slo_burn_enabled: bool = False
+    slo_burn_goal: float = 0.9        # fraction of requests under target
+    slo_burn_fast_ticks: int = 10
+    slo_burn_slow_ticks: int = 120
+    slo_burn_threshold: float = 1.0
 
 
 @dataclass

@@ -16,3 +16,7 @@ from .registry import (  # noqa: F401
     MetricsRegistry,
 )
 from .timeline import StepTimeline  # noqa: F401
+from .events import EVENTS, EventLog  # noqa: F401
+from .clocksync import estimate_offset, merge_fleet_trace  # noqa: F401
+from .slo import BurnObjective, BurnRateEngine  # noqa: F401
+from .postmortem import read_bundle, write_bundle  # noqa: F401

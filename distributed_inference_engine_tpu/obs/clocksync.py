@@ -1,0 +1,198 @@
+"""Clock alignment + fleet trace merge (ISSUE 19 leg 2).
+
+The coordinator and its workers are separate processes with no shared
+monotonic epoch: each worker's ``StepTimeline`` stamps
+``time.perf_counter()`` against ITS OWN process clock, the coordinator's
+``RequestTrace`` marks live on the coordinator's clock, and naive
+concatenation would scatter a single request's life across unrelated
+time origins. This module
+
+1. estimates each worker's clock offset from framed-RPC ping round
+   trips — the classic NTP midpoint method: a pong carrying the server's
+   ``perf_counter`` stamp ``t_s`` bracketed by local stamps ``t0``/``t1``
+   gives ``offset ≈ t_s − (t0+t1)/2`` with error bounded by RTT/2, so we
+   jitter-filter by taking the sample with the SMALLEST round trip over
+   K pings;
+2. merges per-process tracks (StepTimeline dispatches, event-ring
+   instants, request-trace spans) into ONE Chrome trace-event JSON
+   object — one ``pid`` per process, corrected timestamps, loadable
+   directly in Perfetto — so a chaos kill → failover → respawn reads
+   end-to-end on a single timeline.
+
+Pure functions throughout (the one coroutine only awaits the ping
+callable it is handed) — unit-testable with synthetic clocks of mixed
+sign and no RPC plumbing. No jax imports (package discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional
+
+#: one-time delta mapping ``time.monotonic()`` stamps (RequestTrace)
+#: into the ``time.perf_counter()`` domain everything else uses. On
+#: Linux both are CLOCK_MONOTONIC so this is ~0, but the contract is
+#: per-platform: compute it, don't assume it.
+MONO_TO_PERF = time.perf_counter() - time.monotonic()
+
+
+def mono_to_perf(t_monotonic: float) -> float:
+    """Map a ``time.monotonic()`` stamp onto the ``perf_counter`` axis."""
+    return t_monotonic + MONO_TO_PERF
+
+
+async def estimate_offset(
+    ping: Callable[[], Awaitable[Dict[str, Any]]],
+    samples: int = 5,
+) -> Dict[str, float]:
+    """Midpoint clock-offset estimate over ``samples`` ping round trips.
+
+    ``ping`` is an async callable returning a pong dict whose ``mono``
+    field is the server's ``time.perf_counter()`` at handling time
+    (``WorkerServer._rpc_ping``). Returns ``{"offset_s", "rtt_s",
+    "samples"}`` where ``offset_s`` maps REMOTE perf_counter stamps
+    onto the LOCAL axis: ``t_local ≈ t_remote − offset_s``... i.e.
+    ``offset_s = t_remote_mid − t_local_mid``, and a merger subtracts
+    it. Jitter filter: the estimate from the minimum-RTT sample wins
+    (asymmetric queueing corrupts fat round trips first)."""
+    best_rtt = float("inf")
+    best_offset = 0.0
+    got = 0
+    for _ in range(max(1, int(samples))):
+        t0 = time.perf_counter()
+        pong = await ping()
+        t1 = time.perf_counter()
+        t_s = pong.get("mono") if isinstance(pong, dict) else None
+        if not isinstance(t_s, (int, float)):
+            continue                      # old worker: no mono stamp
+        got += 1
+        rtt = t1 - t0
+        if rtt < best_rtt:
+            best_rtt = rtt
+            best_offset = float(t_s) - (t0 + t1) / 2.0
+    return {"offset_s": best_offset if got else 0.0,
+            "rtt_s": best_rtt if got else 0.0,
+            "samples": float(got)}
+
+
+# -- fleet trace merge -----------------------------------------------------
+
+#: tid layout inside each process track (Perfetto renders one lane per
+#: tid; fixed numbering keeps same-seed traces byte-comparable)
+TID_EVENTS = 0
+TID_REQUESTS = 1
+TID_STEPS = 2
+
+_TID_NAMES = {TID_EVENTS: "events", TID_REQUESTS: "requests",
+              TID_STEPS: "steps"}
+
+
+def merge_fleet_trace(
+        tracks: List[Dict[str, Any]],
+        label: str = "fleet") -> Dict[str, Any]:
+    """Merge per-process tracks into one Chrome trace-event JSON object.
+
+    Each track is a dict::
+
+        {"name":     str,          # process name ("coordinator", "w1")
+         "offset_s": float,        # remote→local clock offset (0 local)
+         "steps":    [{"name","t","dur","args"}, ...],   # StepTimeline
+         "spans":    [{"name","t","dur","args"}, ...],   # request spans
+         "events":   [{"type","t_mono","args",...}, ...]}  # event ring
+
+    ``t`` stamps are the source process's raw ``perf_counter`` values;
+    correction is ``t − offset_s``. All corrected stamps share one
+    global epoch (the minimum across every track) so ``ts`` is µs from
+    the earliest fleet moment. Output events are sorted per (pid, tid)
+    by corrected time — per-track monotonicity is a structural property
+    of the result, which the tests assert under mixed-sign offsets.
+    """
+    out: List[Dict[str, Any]] = []
+    corrected: List[Dict[str, Any]] = []
+
+    for pid0, track in enumerate(tracks):
+        pid = pid0 + 1
+        name = str(track.get("name", f"proc{pid}"))
+        off = float(track.get("offset_s", 0.0))
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": name}})
+        for tid, tname in _TID_NAMES.items():
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+        for e in track.get("steps") or ():
+            corrected.append({"name": e["name"], "t": e["t"] - off,
+                              "dur": e.get("dur"), "args": e.get("args"),
+                              "pid": pid, "tid": TID_STEPS})
+        for e in track.get("spans") or ():
+            corrected.append({"name": e["name"], "t": e["t"] - off,
+                              "dur": e.get("dur"), "args": e.get("args"),
+                              "pid": pid, "tid": TID_REQUESTS})
+        for e in track.get("events") or ():
+            t = e.get("t_mono")
+            if not isinstance(t, (int, float)):
+                continue
+            corrected.append({"name": e.get("type", "event"),
+                              "t": float(t) - off, "dur": None,
+                              "args": e.get("args"),
+                              "pid": pid, "tid": TID_EVENTS})
+
+    epoch = min((c["t"] for c in corrected), default=0.0)
+    corrected.sort(key=lambda c: (c["pid"], c["tid"], c["t"]))
+    for c in corrected:
+        ts = (c["t"] - epoch) * 1e6
+        args = dict(c["args"] or {})
+        if c["dur"] is None:
+            out.append({"name": c["name"], "ph": "i", "s": "t", "ts": ts,
+                        "pid": c["pid"], "tid": c["tid"], "args": args})
+        else:
+            out.append({"name": c["name"], "ph": "X", "ts": ts,
+                        "dur": float(c["dur"]) * 1e6,
+                        "pid": c["pid"], "tid": c["tid"], "args": args})
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "metadata": {"timeline": label, "tracks": len(tracks),
+                     "events": len(corrected)},
+    }
+
+
+def spans_from_trace_marks(
+        marks: Dict[str, float],
+        request_id: str = "") -> List[Dict[str, Any]]:
+    """Turn one ``RequestTrace.marks`` dict (absolute ``time.monotonic``
+    stamps) into merge-ready span events on the perf_counter axis.
+
+    Emits one complete event per well-known phase PAIR that is present,
+    plus instant-free coverage of the whole life as a ``request`` span
+    (received → last mark). Non-terminal traces (no ``responded`` /
+    ``failed`` mark) still render — their last mark bounds the span —
+    but bench's ``dump_obs`` filters them out upstream."""
+    if not marks:
+        return []
+    pairs = (("dispatched", "merged", "dispatch"),
+             ("routed", "dispatched", "route"),
+             ("received", "routed", "admit"))
+    spans: List[Dict[str, Any]] = []
+    t0 = min(marks.values())
+    t1 = max(marks.values())
+    args = {k: round(v - t0, 6) for k, v in marks.items()}
+    if request_id:
+        args["request_id"] = request_id
+    spans.append({"name": "request", "t": mono_to_perf(t0),
+                  "dur": max(0.0, t1 - t0), "args": args})
+    for start, end, name in pairs:
+        if start in marks and end in marks and marks[end] >= marks[start]:
+            spans.append({"name": name, "t": mono_to_perf(marks[start]),
+                          "dur": marks[end] - marks[start],
+                          "args": {"request_id": request_id}
+                          if request_id else {}})
+    return spans
+
+
+def dump_trace(path: str, trace: Dict[str, Any]) -> str:
+    """Atomic write (tmp+rename) so a crash mid-dump never leaves
+    Perfetto a half-JSON."""
+    from ..utils.files import atomic_write
+
+    return atomic_write(path, lambda f: json.dump(trace, f))

@@ -436,6 +436,24 @@ EXTRA_FAMILIES = [
      "Model+prefix affinity picks with no live binding (cold key)"),
     ("lb_model_affinity_rebinds", "c", ("model",),
      "Model+prefix bindings moved off a dead/drained worker"),
+    ("obs_scrape_seconds", "h", ("server",),
+     "Wall time to collect and render one /metrics exposition"),
+    ("obs_scrape_ok", "g", ("server",),
+     "1 if the last /metrics scrape rendered without error"),
+    ("obs_events_emitted", "c", ("proc",),
+     "Typed fleet events emitted into this process's ring"),
+    ("obs_events_dropped", "c", ("proc",),
+     "Fleet events overwritten by ring wrap (oldest evicted)"),
+    ("slo_ticks", "c", (),
+     "SLO burn-rate engine evaluation ticks"),
+    ("slo_burn_rate_fast", "g", ("objective",),
+     "Fast-window error-budget burn rate (1.0 = budget-neutral)"),
+    ("slo_burn_rate_slow", "g", ("objective",),
+     "Slow-window error-budget burn rate (1.0 = budget-neutral)"),
+    ("slo_breach_active", "g", ("objective",),
+     "1 while this objective's multi-window burn breach is engaged"),
+    ("slo_breach_transitions", "c", ("objective",),
+     "Burn-breach on/off transitions for this objective"),
 ]
 
 _GROUPS: List[Tuple[List, Tuple[str, ...]]] = [
@@ -652,6 +670,65 @@ def apply_upgrade(reg: MetricsRegistry,
     """A ``RollingUpgrade.get_stats()`` dict."""
     if s:
         _apply_table(reg, UPGRADE_TABLE, s, (), {})
+
+
+def apply_slo(reg: MetricsRegistry, s: Optional[Mapping[str, Any]]) -> None:
+    """A ``BurnRateEngine.get_stats()`` dict: tick counter plus the
+    per-objective burn gauges and transition counters."""
+    if not s:
+        return
+    if "ticks" in s:
+        reg.counter("slo_ticks", CATALOG["slo_ticks"][2]).labels().set(
+            float(s["ticks"]))
+    objectives = s.get("objectives")
+    if not isinstance(objectives, Mapping):
+        return
+    fams = {
+        "burn_fast": reg.gauge("slo_burn_rate_fast",
+                               CATALOG["slo_burn_rate_fast"][2],
+                               ("objective",)),
+        "burn_slow": reg.gauge("slo_burn_rate_slow",
+                               CATALOG["slo_burn_rate_slow"][2],
+                               ("objective",)),
+        "breach_active": reg.gauge("slo_breach_active",
+                                   CATALOG["slo_breach_active"][2],
+                                   ("objective",)),
+        "transitions": reg.counter("slo_breach_transitions",
+                                   CATALOG["slo_breach_transitions"][2],
+                                   ("objective",)),
+    }
+    for name, rec in objectives.items():
+        if isinstance(rec, Mapping):
+            for key, fam in fams.items():
+                if key in rec:
+                    fam.labels(objective=str(name)).set(float(rec[key]))
+
+
+def apply_event_log(reg: MetricsRegistry, s: Optional[Mapping[str, Any]],
+                    proc: str) -> None:
+    """An ``EventLog.get_stats()`` dict for one process's ring."""
+    if not s:
+        return
+    labels = {"proc": str(proc)}
+    reg.counter("obs_events_emitted", CATALOG["obs_events_emitted"][2],
+                ("proc",)).labels(**labels).set(
+                    float(s.get("events_emitted", 0)))
+    reg.counter("obs_events_dropped", CATALOG["obs_events_dropped"][2],
+                ("proc",)).labels(**labels).set(
+                    float(s.get("events_dropped", 0)))
+
+
+def record_scrape(reg: MetricsRegistry, server: str, seconds: float,
+                  ok: bool) -> None:
+    """Self-observation for the /metrics plane: one scrape's collect+
+    render wall time and outcome, recorded AFTER rendering so it shows
+    up on the NEXT exposition (a scrape cannot time itself into its own
+    output)."""
+    labels = {"server": str(server)}
+    reg.histogram("obs_scrape_seconds", CATALOG["obs_scrape_seconds"][2],
+                  ("server",)).labels(**labels).observe(float(seconds))
+    reg.gauge("obs_scrape_ok", CATALOG["obs_scrape_ok"][2],
+              ("server",)).labels(**labels).set(1.0 if ok else 0.0)
 
 
 def apply_worker(reg: MetricsRegistry, wm: Optional[Mapping[str, Any]],

@@ -1,0 +1,182 @@
+"""Structured fleet event log (ISSUE 19 leg 1): a bounded per-process
+ring of TYPED events covering the fleet control plane — admission
+decisions, dispatch retries/failovers, drains, KV-fabric transfers,
+model stage/swap, chaos fault injections, and breaker/respawn/crash-loop
+transitions.
+
+Schema discipline mirrors the metric catalog in ``collectors.py``: the
+``EVENTS`` table below is the single source of truth (name → help), the
+docs table in ``docs/observability.md`` is linted against it in BOTH
+directions (``scripts/graftlint`` drift rule), and ``EventLog.emit``
+rejects unknown types at the call site so a typo cannot mint an
+undocumented event family at runtime.
+
+Each record is ``{"seq", "type", "t_wall", "t_mono", "args"}``:
+
+- ``seq``   — per-process monotone sequence number (never reset, so a
+  ring wrap is visible as a gap at the front);
+- ``t_wall`` — ``time.time()`` for human-readable cross-host anchoring;
+- ``t_mono`` — ``time.perf_counter()``, the clock ``clocksync`` aligns
+  across processes for the merged fleet trace;
+- ``args``  — small JSON-safe payload (worker ids, request ids, counts).
+
+Determinism: ``canonical_sequence()`` strips seq and both timestamps so
+two same-seed chaos runs can assert identical event SEQUENCES even
+though wall time differs.
+
+No jax imports (package discipline — see ``obs/__init__``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+#: event type → help. The docs event catalog is linted against exactly
+#: this mapping (scripts/graftlint drift rule ``drift-events-docs``).
+EVENTS: Dict[str, str] = {
+    # -- admission (coordinator gate + worker-side pump gate) --------------
+    "admission.shed": "Request shed at coordinator admission "
+                      "(fleet-level degradation gate)",
+    "admission.accept": "Request admitted into an engine pump inbox",
+    "admission.reject": "Request refused by a pump (inbox full / "
+                        "overload shed)",
+    # -- dispatch ----------------------------------------------------------
+    "dispatch.retry": "Dispatch re-tried on another replica after a "
+                      "transport failure or draining shed",
+    "dispatch.failover": "Stream resumed on an alternate worker via "
+                         "prefix replay",
+    # -- drain -------------------------------------------------------------
+    "drain.begin": "Graceful drain started (worker stops admitting)",
+    "drain.done": "Drain completed (in-flight work quiesced)",
+    # -- KV fabric ---------------------------------------------------------
+    "fabric.export": "kv_export RPC produced a prefix wire",
+    "fabric.import": "kv_import RPC landed pages in the host KV tier",
+    # -- model lifecycle ---------------------------------------------------
+    "model.stage": "Background model stage started on a worker",
+    "model.swap": "Hot swap activated a staged model",
+    # -- chaos -------------------------------------------------------------
+    "fault.injected": "Seeded chaos fault fired in this process's "
+                      "RPC plane",
+    # -- breaker / supervisor transitions ----------------------------------
+    "breaker.open": "LB circuit breaker opened for a worker",
+    "breaker.half_open": "LB circuit breaker moved to half-open "
+                         "(probation)",
+    "breaker.close": "LB circuit breaker closed (worker healthy again)",
+    "respawn.begin": "Supervisor detected a dead worker and began "
+                     "respawning it",
+    "respawn.done": "Supervisor respawn completed (worker re-admitted)",
+    "crashloop.open": "Crash-loop breaker opened (worker given up on)",
+    "upgrade.rollback": "Rolling upgrade rolled a worker back after a "
+                        "failed golden probe",
+    # -- SLO burn-rate engine ----------------------------------------------
+    "slo.burn_on": "SLO burn-rate breach engaged (fast+slow windows "
+                   "both burning)",
+    "slo.burn_off": "SLO burn-rate breach cleared",
+    # -- post-mortem -------------------------------------------------------
+    "postmortem.bundle": "Crash post-mortem bundle written",
+}
+
+
+class EventLog:
+    """Bounded, thread-safe ring of typed events for one process.
+
+    ``proc`` names the owning process track in the merged fleet trace
+    (e.g. ``"coordinator"`` or a worker id). Emission is cheap — one
+    dict append under a lock — and never raises for ring pressure
+    (drops are counted, the newest event always lands).
+    """
+
+    def __init__(self, proc: str, capacity: int = 2048) -> None:
+        self.proc = str(proc)
+        self.capacity = max(1, int(capacity))
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def emit(self, etype: str, **args: Any) -> None:
+        """Append one typed event. Unknown types raise ``ValueError`` —
+        the catalog above is the schema, enforced at the call site."""
+        if etype not in EVENTS:
+            raise ValueError(f"unknown event type {etype!r} (add it to "
+                             "obs.events.EVENTS and the docs catalog)")
+        rec = {
+            "seq": 0,                    # patched under the lock below
+            "type": etype,
+            "t_wall": time.time(),
+            "t_mono": time.perf_counter(),
+            "args": args,
+        }
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(rec)
+
+    # -- export ------------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Stable wire/bundle form: the whole ring plus schema and drop
+        accounting. This is what the ``events`` RPC verb returns and
+        what post-mortem bundles persist."""
+        with self._lock:
+            return {
+                "schema": SCHEMA_VERSION,
+                "proc": self.proc,
+                "seq": self._seq,
+                "dropped": self._dropped,
+                "events": [dict(e) for e in self._events],
+            }
+
+    def canonical_sequence(self) -> List[Tuple[str, Tuple]]:
+        """Timestamp-free event sequence for same-seed determinism
+        assertions: ``[(type, sorted(args.items())), ...]`` in emission
+        order (seq order — stable within one process)."""
+        with self._lock:
+            return [
+                (e["type"], tuple(sorted(
+                    (k, _canon(v)) for k, v in e["args"].items())))
+                for e in self._events
+            ]
+
+    def get_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"events_emitted": self._seq,
+                    "events_dropped": self._dropped,
+                    "events_buffered": len(self._events)}
+
+
+def _canon(v: Any) -> Any:
+    """JSON-safe, hashable canonical form for determinism comparison
+    (floats that encode durations are excluded upstream — args should
+    carry ids and counts, not timings)."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canon(x)) for k, x in v.items()))
+    return v
+
+
+def canonical_from_snapshot(snap: Dict[str, Any]) -> List[Tuple[str, Tuple]]:
+    """``canonical_sequence`` over a serialized ``snapshot()`` (e.g. one
+    collected over RPC or read back from a post-mortem bundle)."""
+    out: List[Tuple[str, Tuple]] = []
+    for e in snap.get("events", ()):
+        args = e.get("args") or {}
+        out.append((e["type"], tuple(sorted(
+            (k, _canon(v)) for k, v in args.items()))))
+    return out

@@ -1,0 +1,147 @@
+"""Crash post-mortem bundles (ISSUE 19 leg 4).
+
+When a worker dies the evidence used to die with it: its event ring,
+its place in the fleet timeline, the fault that killed it. A bundle is
+one directory capturing everything the coordinator can still reach at
+the moment of a supervisor-detected death, crash-loop open, upgrade
+rollback, or chaos-leg failure:
+
+    <dir>/<bundle-name>/
+        manifest.json     reason, dead workers, file inventory, counts
+        trace.json        merged fleet Perfetto trace (clocksync)
+        metrics.prom      OpenMetrics registry snapshot at dump time
+        rings.json        survivors' event rings (fresh collection)
+        dead_rings.json   dead workers' LAST-KNOWN rings from the
+                          coordinator's collection cache
+        faults.json       the chaos fault ledger (plan.sequence())
+
+Every JSON file goes through ``utils.files.atomic_write_json`` and the
+``.prom`` snapshot through ``atomic_write`` — a crash mid-dump never
+leaves a half-parseable bundle. Writing is best-effort by contract:
+callers fire it from supervision paths and must never let a dump
+failure take down the control loop, so ``write_bundle`` itself only
+raises for an unusable destination directory.
+
+No jax imports (package discipline — see ``obs/__init__``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..utils.files import atomic_write, atomic_write_json
+
+BUNDLE_SCHEMA = 1
+
+
+def _bundle_name(dir_path: str, reason: str) -> str:
+    """Collision-free bundle directory name: wall-clock stamp + reason,
+    suffixed with a counter when two dumps land in the same second."""
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    safe = "".join(c if (c.isalnum() or c in "-_") else "-"
+                   for c in reason) or "unknown"
+    base = f"postmortem-{stamp}-{safe}"
+    name, n = base, 1
+    while os.path.exists(os.path.join(dir_path, name)):
+        name = f"{base}-{n}"
+        n += 1
+    return name
+
+
+def write_bundle(
+    dir_path: str,
+    reason: str,
+    *,
+    trace: Optional[Dict[str, Any]] = None,
+    metrics_text: str = "",
+    event_rings: Optional[Dict[str, Dict[str, Any]]] = None,
+    dead_rings: Optional[Dict[str, Dict[str, Any]]] = None,
+    fault_ledger: Optional[Sequence] = None,
+    dead_workers: Sequence[str] = (),
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Dump one post-mortem bundle under ``dir_path``; returns the
+    bundle directory path.
+
+    ``event_rings`` / ``dead_rings`` map process name → ring
+    ``snapshot()`` dicts; ``trace`` is a merged Chrome trace object
+    (``clocksync.merge_fleet_trace``); ``fault_ledger`` is the
+    order-independent ``FaultPlan.sequence()`` (or an equivalent list).
+    Only the files whose payload was provided are written — the
+    manifest records which, so bundle readers need no sniffing."""
+    os.makedirs(dir_path, exist_ok=True)
+    bundle = os.path.join(dir_path, _bundle_name(dir_path, reason))
+    os.makedirs(bundle, exist_ok=True)
+
+    files: List[str] = []
+    if trace is not None:
+        atomic_write_json(os.path.join(bundle, "trace.json"), trace,
+                          indent=0)
+        files.append("trace.json")
+    if metrics_text:
+        atomic_write(os.path.join(bundle, "metrics.prom"),
+                     lambda f: f.write(metrics_text))
+        files.append("metrics.prom")
+    if event_rings is not None:
+        atomic_write_json(os.path.join(bundle, "rings.json"), event_rings)
+        files.append("rings.json")
+    if dead_rings is not None:
+        atomic_write_json(os.path.join(bundle, "dead_rings.json"),
+                          dead_rings)
+        files.append("dead_rings.json")
+    if fault_ledger is not None:
+        atomic_write_json(os.path.join(bundle, "faults.json"),
+                          [list(e) if isinstance(e, tuple) else e
+                           for e in fault_ledger])
+        files.append("faults.json")
+
+    manifest = {
+        "schema": BUNDLE_SCHEMA,
+        "reason": reason,
+        "wall_time": time.time(),
+        "dead_workers": sorted(str(w) for w in dead_workers),
+        "files": sorted(files),
+        "counts": {
+            "trace_events": len((trace or {}).get("traceEvents", ())),
+            "rings": len(event_rings or {}),
+            "dead_rings": len(dead_rings or {}),
+            "faults": len(fault_ledger or ()),
+        },
+    }
+    if extra:
+        manifest["extra"] = extra
+    atomic_write_json(os.path.join(bundle, "manifest.json"), manifest)
+    return bundle
+
+
+def read_bundle(bundle: str) -> Dict[str, Any]:
+    """Load a bundle back (receipt printers, tests). Returns the
+    manifest plus each present payload under its file stem."""
+    import json
+
+    out: Dict[str, Any] = {}
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        out["manifest"] = json.load(f)
+    for fname in out["manifest"].get("files", ()):
+        p = os.path.join(bundle, fname)
+        stem = os.path.splitext(fname)[0]
+        if fname.endswith(".json"):
+            with open(p) as f:
+                out[stem] = json.load(f)
+        else:
+            with open(p) as f:
+                out[stem] = f.read()
+    return out
+
+
+def list_bundles(dir_path: str) -> List[str]:
+    """Bundle directories under ``dir_path``, oldest first (name order —
+    names embed the wall-clock stamp)."""
+    if not os.path.isdir(dir_path):
+        return []
+    return sorted(
+        os.path.join(dir_path, n) for n in os.listdir(dir_path)
+        if n.startswith("postmortem-")
+        and os.path.isfile(os.path.join(dir_path, n, "manifest.json")))

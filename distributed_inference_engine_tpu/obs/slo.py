@@ -1,0 +1,173 @@
+"""SLO burn-rate engine (ISSUE 19 leg 3): multi-window error-budget
+burn evaluation over the fleet's existing latency histograms.
+
+The autoscaler's instantaneous attainment signal answers "is this tick
+bad?"; burn rate answers "are we spending the error budget faster than
+the SLO allows?" — the standard SRE multi-window construction: with an
+objective of ``goal`` attainment (e.g. 0.9 → 10% error budget), the
+burn rate over a window is::
+
+    burn = (violating / total) / (1 - goal)
+
+and a breach engages only when BOTH a fast window (reacts in seconds)
+and a slow window (suppresses blips) burn above a threshold — the fast
+window gives detection latency, the slow window gives precision.
+
+Determinism: the engine is TICK-counted, not wall-clocked. Windows are
+rings of per-tick ``(total, violating)`` deltas fed by the caller (the
+autoscaler's existing scrape-window differ), and the decision ledger
+records only objective names and transition kinds — no tick indices, no
+rates, no timestamps — so two same-seed runs produce byte-identical
+ledgers even when their tick counts drift by scheduling jitter.
+
+No jax imports (package discipline — see ``obs/__init__``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class BurnObjective:
+    """One SLO: ``goal`` is the target attainment fraction (0.9 → at
+    most 10% of requests may violate the latency bound)."""
+
+    name: str
+    goal: float = 0.9
+
+    @property
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - float(self.goal))
+
+
+def violations_from_buckets(buckets: Mapping[str, float], total: float,
+                            bound_s: float) -> float:
+    """Count observations ABOVE ``bound_s`` from a cumulative-bucket
+    histogram window (``le``-labelled, ``+Inf`` last — the
+    ``LatencyStats.bucket_counts`` shape).
+
+    Conservative bound snapping: the smallest bucket bound ≥ ``bound_s``
+    defines "good" — with the shared ``LATENCY_BUCKETS`` grid and
+    targets picked on grid points this is exact."""
+    if total <= 0:
+        return 0.0
+    best_le: Optional[float] = None
+    best_cum = 0.0
+    for le, cum in buckets.items():
+        b = float("inf") if le in ("+Inf", "inf") else float(le)
+        if b >= bound_s and (best_le is None or b < best_le):
+            best_le, best_cum = b, float(cum)
+    if best_le is None:
+        return 0.0
+    return max(0.0, float(total) - best_cum)
+
+
+class _Window:
+    """Ring of per-tick (total, violating) deltas with running sums."""
+
+    def __init__(self, ticks: int) -> None:
+        self._ring: deque = deque(maxlen=max(1, int(ticks)))
+        self.total = 0.0
+        self.bad = 0.0
+
+    def push(self, total: float, bad: float) -> None:
+        if len(self._ring) == self._ring.maxlen:
+            old_t, old_b = self._ring[0]
+            self.total -= old_t
+            self.bad -= old_b
+        self._ring.append((total, bad))
+        self.total += total
+        self.bad += bad
+
+    def error_rate(self) -> float:
+        return (self.bad / self.total) if self.total > 0 else 0.0
+
+
+class BurnRateEngine:
+    """Multi-window burn-rate evaluator over tick-fed window counts.
+
+    ``observe()`` takes one tick's per-objective ``(total, violating)``
+    DELTAS (not cumulative counts) and returns the transitions it
+    caused; ``breached()`` is the instantaneous gate the autoscaler
+    consults behind its config flag.
+    """
+
+    def __init__(self, objectives: List[BurnObjective],
+                 fast_ticks: int = 10, slow_ticks: int = 120,
+                 threshold: float = 1.0) -> None:
+        self.objectives = list(objectives)
+        self.threshold = float(threshold)
+        self.fast_ticks = max(1, int(fast_ticks))
+        self.slow_ticks = max(self.fast_ticks, int(slow_ticks))
+        self._fast: Dict[str, _Window] = {
+            o.name: _Window(self.fast_ticks) for o in self.objectives}
+        self._slow: Dict[str, _Window] = {
+            o.name: _Window(self.slow_ticks) for o in self.objectives}
+        self._active: Dict[str, bool] = {
+            o.name: False for o in self.objectives}
+        self._transitions: Dict[str, int] = {
+            o.name: 0 for o in self.objectives}
+        self._ledger: List[Dict[str, str]] = []
+        self.ticks = 0
+
+    def observe(self, counts: Mapping[str, Tuple[float, float]],
+                ) -> List[Dict[str, str]]:
+        """Feed one evaluation tick. ``counts`` maps objective name →
+        ``(total, violating)`` for THIS tick's window delta; missing
+        objectives contribute an empty tick (windows still advance so
+        quiet periods age breaches out). Returns the transitions this
+        tick appended to the ledger."""
+        self.ticks += 1
+        out: List[Dict[str, str]] = []
+        for obj in self.objectives:
+            total, bad = counts.get(obj.name, (0.0, 0.0))
+            total = max(0.0, float(total))
+            bad = min(max(0.0, float(bad)), total)
+            self._fast[obj.name].push(total, bad)
+            self._slow[obj.name].push(total, bad)
+            burning = (self.burn_rate(obj.name, fast=True) >= self.threshold
+                       and self.burn_rate(obj.name, fast=False)
+                       >= self.threshold)
+            if burning != self._active[obj.name]:
+                self._active[obj.name] = burning
+                self._transitions[obj.name] += 1
+                entry = {"objective": obj.name,
+                         "event": "burn_on" if burning else "burn_off"}
+                self._ledger.append(entry)
+                out.append(entry)
+        return out
+
+    def burn_rate(self, name: str, fast: bool = True) -> float:
+        obj = next(o for o in self.objectives if o.name == name)
+        win = (self._fast if fast else self._slow)[name]
+        return win.error_rate() / obj.budget
+
+    def breached(self) -> bool:
+        """True while ANY objective's breach is engaged."""
+        return any(self._active.values())
+
+    def breached_objectives(self) -> List[str]:
+        return [n for n, a in self._active.items() if a]
+
+    def ledger(self) -> List[Dict[str, str]]:
+        """The decision ledger: transitions only, timestamp- and
+        tick-free — the same-seed determinism artifact."""
+        return list(self._ledger)
+
+    def get_stats(self) -> Dict[str, Any]:
+        """Collector-ready shape (``obs.collectors.apply_slo``)."""
+        return {
+            "ticks": self.ticks,
+            "objectives": {
+                o.name: {
+                    "burn_fast": self.burn_rate(o.name, fast=True),
+                    "burn_slow": self.burn_rate(o.name, fast=False),
+                    "breach_active": 1.0 if self._active[o.name] else 0.0,
+                    "transitions": self._transitions[o.name],
+                    "goal": o.goal,
+                } for o in self.objectives
+            },
+        }

@@ -45,8 +45,14 @@ class EnginePump:
     def __init__(self, engine: Any, idle_wait_s: float = 0.25,
                  error_backoff_s: float = 0.05,
                  mixed_step_tokens: Optional[int] = None,
-                 overlap_forms: bool = True) -> None:
+                 overlap_forms: bool = True,
+                 event_log: Any = None, model: str = "") -> None:
         self.engine = engine
+        # flight recorder (obs/events.py): admission accept/reject land in
+        # the owning worker's event ring. EventLog is lock-guarded, so
+        # emitting from the pump thread is safe.
+        self._events = event_log
+        self._model = model
         self.idle_wait_s = idle_wait_s          # safety-net poll when idle
         self.error_backoff_s = error_backoff_s  # pause after a failed step
         if mixed_step_tokens is not None:
@@ -271,6 +277,9 @@ class EnginePump:
                     prefetch = getattr(self.engine, "prefetch_probe", None)
                     if prefetch is not None:
                         prefetch(req)
+                if self._events is not None:
+                    self._events.emit("admission.accept", model=self._model,
+                                      request_id=original_id or pump_id)
             except EngineOverloadedError as e:
                 # per-request outcome, not an exception: batch siblings
                 # already submitted must keep their futures resolvable
@@ -282,6 +291,10 @@ class EnginePump:
                     prompt_tokens=len(req.prompt),
                     metadata={"overload_reason": e.reason},
                 )
+                if self._events is not None:
+                    self._events.emit("admission.reject", model=self._model,
+                                      request_id=original_id or pump_id,
+                                      reason=e.reason)
                 loop.call_soon_threadsafe(self._set_result, fut, shed)
             except Exception as e:
                 del self._futures[pump_id]

@@ -93,6 +93,20 @@ class FaultPlan:
         self.log: List[InjectedFault] = []
         self._ordinals: Dict[Tuple[str, str, str], int] = {}
         self._fired: List[int] = [0] * len(self.specs)
+        self._listeners: List = []
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(InjectedFault)`` to fire on every injection —
+        the flight recorder's hook (workers filter by their own scope).
+        Listeners must be cheap and must not raise (guarded anyway)."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def draw(self, scope: str, site: str, verb: str) -> Optional[FaultSpec]:
         """Decide whether the call identified by (scope, site, verb) at
@@ -112,7 +126,13 @@ class FaultPlan:
                 continue
             if _unit(self.seed, i, scope, site, verb, n) < spec.rate:
                 self._fired[i] += 1
-                self.log.append(InjectedFault(scope, site, verb, n, spec.kind))
+                fault = InjectedFault(scope, site, verb, n, spec.kind)
+                self.log.append(fault)
+                for fn in self._listeners:
+                    try:
+                        fn(fault)
+                    except Exception:  # telemetry must not break injection
+                        pass
                 return spec
         return None
 

@@ -55,11 +55,15 @@ def expected_tokens(prompt, n):
     return out
 
 
-async def start_fleet(n_workers, seed, rate, step_latency_s=0.005):
+async def start_fleet(n_workers, seed, rate, step_latency_s=0.005,
+                      postmortem_dir=""):
     plan = FaultPlan(seed=seed, specs=default_menu(
         rate=rate, delay_s=0.005, verbs=("generate",)))
     coord = Coordinator(CoordinatorConfig(
-        retry_seed=seed, retry_backoff_base_s=0.01))
+        retry_seed=seed, retry_backoff_base_s=0.01,
+        postmortem_dir=postmortem_dir))
+    # the bundle's faults.json is this plan's canonical sequence
+    coord.fault_plan = plan
     await coord.start()
     cfg = ModelConfig(name="m", architecture="fake", metadata={
         "continuous": 1, "max_slots": 4, "step_latency_s": step_latency_s})
@@ -84,8 +88,9 @@ async def stop_fleet(coord, workers):
             pass
 
 
-async def chaos_run(n_workers, n_requests, seed, rate):
-    coord, workers, cfg, plan = await start_fleet(n_workers, seed, rate)
+async def chaos_run(n_workers, n_requests, seed, rate, postmortem_dir=""):
+    coord, workers, cfg, plan = await start_fleet(
+        n_workers, seed, rate, postmortem_dir=postmortem_dir)
     print(f"=== chaos run: {n_workers} workers, {n_requests} requests, "
           f"seed={seed}, fault rate={rate} ===")
     prompts = [[100 + i, i % 7, 3] for i in range(n_requests)]
@@ -98,6 +103,12 @@ async def chaos_run(n_workers, n_requests, seed, rate):
     # gracefully drain another — all while the load is in flight
     await asyncio.sleep(0.1)
     victim = f"w{n_workers - 1}"
+    if postmortem_dir:
+        # cache every ring + clock offset BEFORE the kill: the victim's
+        # cached ring is what the post-mortem bundle preserves as
+        # dead_rings.json (it cannot be re-collected from a corpse)
+        await coord.estimate_offsets()
+        await coord.collect_events()
     print(f"  !! hard-killing {victim} (no drain, in-flight work dies)")
     await workers.pop(victim).stop()
 
@@ -143,8 +154,47 @@ async def chaos_run(n_workers, n_requests, seed, rate):
           f"dispatch_retries={stats['dispatch_retries']} "
           f"drains={stats['drains']} "
           f"overload_rejections={stats['overload_rejections']}")
+    pm_ok = True
+    if postmortem_dir:
+        pm_ok = await postmortem_receipt(coord, plan, victim,
+                                         postmortem_dir)
     await stop_fleet(coord, workers)
-    return ok, dupes
+    return ok, dupes, pm_ok
+
+
+async def postmortem_receipt(coord, plan, victim, postmortem_dir):
+    """The hard-kill leg's flight-recorder receipt: bundle the incident,
+    then assert the merged trace carries >=3 process tracks (coordinator
+    + at least two workers), per-track monotone corrected timestamps, the
+    dead worker's cached ring, and the injected-fault ledger."""
+    from distributed_inference_engine_tpu.obs import postmortem as pm
+
+    bundle = await coord.write_postmortem("chaos_hard_kill",
+                                          dead_workers=(victim,))
+    data = pm.read_bundle(bundle)
+    trace = data.get("trace") or {}
+    events = trace.get("traceEvents", [])
+    tracks = sum(1 for e in events if e.get("name") == "process_name")
+    last = {}
+    monotone = True
+    for e in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        if e.get("ph") == "M":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        if e["ts"] < last.get(key, float("-inf")):
+            monotone = False
+        last[key] = e["ts"]
+    dead = data.get("dead_rings") or {}
+    faults = data.get("faults") or []
+    checks = {
+        "tracks>=3": tracks >= 3,
+        "per_track_monotone": monotone,
+        "dead_ring_preserved": victim in dead,
+        "fault_ledger": len(faults) == len(plan.sequence()) > 0,
+    }
+    print(f"  postmortem bundle -> {bundle}")
+    print(f"  receipt: {checks}")
+    return all(checks.values())
 
 
 async def supervisor_run(n_workers, n_requests, seed, rate):
@@ -284,35 +334,61 @@ async def replay_run(seed, n=16):
         coord.add_worker(f"w{i}", host, port)
     await coord.deploy_model(cfg)
     outcomes = []
+    # same-seed SLO burn ledger: one tick per request outcome — a pure
+    # function of the outcome sequence, so it must replay byte-identical
+    from distributed_inference_engine_tpu.obs.slo import (
+        BurnObjective, BurnRateEngine,
+    )
+
+    burn = BurnRateEngine([BurnObjective("ok", goal=0.9)],
+                          fast_ticks=4, slow_ticks=8)
     for i in range(n):
         try:
             r = await coord.submit("m", prompt=[200 + i, 1],
                                    max_new_tokens=4, no_cache=True,
                                    key=f"k{i}", request_id=f"r{i}")
             outcomes.append((i, r["finish_reason"]))
+            burn.observe({"ok": (1.0, 0.0 if r["finish_reason"] == "stop"
+                                 else 1.0)})
         except Exception as e:
             outcomes.append((i, type(e).__name__))
+            burn.observe({"ok": (1.0, 1.0)})
+    # canonical (timestamp-free) per-process event sequences: the flight
+    # recorder's determinism artifact for same-seed replay comparison
+    rings = {wid: w.events.canonical_sequence()
+             for wid, w in workers.items()}
+    rings["coordinator"] = coord.events.canonical_sequence()
     await stop_fleet(coord, workers)
-    return plan.sequence(), outcomes
+    return plan.sequence(), outcomes, rings, burn.ledger()
 
 
 async def main_async(args):
-    ok, dupes = await chaos_run(args.workers, args.requests, args.seed,
-                                args.rate)
+    ok, dupes, pm_ok = await chaos_run(args.workers, args.requests,
+                                       args.seed, args.rate,
+                                       postmortem_dir=args.postmortem_dir)
     supervised_ok = await supervisor_run(args.workers, args.requests,
                                          args.seed, args.rate)
     print("=== reproducibility: two sequential runs, same seed ===")
-    seq_a, out_a = await replay_run(args.seed)
-    seq_b, out_b = await replay_run(args.seed)
+    seq_a, out_a, rings_a, burn_a = await replay_run(args.seed)
+    seq_b, out_b, rings_b, burn_b = await replay_run(args.seed)
     same = seq_a == seq_b and out_a == out_b
+    same_events = rings_a == rings_b
+    same_burn = burn_a == burn_b
     print(f"  run A injected {len(seq_a)} faults, run B {len(seq_b)} — "
           f"sequences {'IDENTICAL' if same else 'DIVERGED'}")
+    print(f"  event sequences (timestamp-free): "
+          f"{'IDENTICAL' if same_events else 'DIVERGED'} "
+          f"({sum(len(v) for v in rings_a.values())} events across "
+          f"{len(rings_a)} rings)")
+    print(f"  SLO burn ledgers: {'IDENTICAL' if same_burn else 'DIVERGED'} "
+          f"({len(burn_a)} transitions)")
     for entry in seq_a[:6]:
         print(f"    {entry}")
     if len(seq_a) > 6:
         print(f"    ... {len(seq_a) - 6} more")
     print("=== done ===")
-    if ok < 0.99 * args.requests or dupes or not same or not supervised_ok:
+    if (ok < 0.99 * args.requests or dupes or not same or not supervised_ok
+            or not same_events or not same_burn or not pm_ok):
         return 1
     return 0
 
@@ -324,6 +400,10 @@ def main():
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--rate", type=float, default=0.08,
                     help="per-call fault probability for the full menu")
+    ap.add_argument("--postmortem-dir", default="",
+                    help="write a crash post-mortem bundle for the "
+                         "hard-kill leg into this directory (and assert "
+                         "its receipt)")
     args = ap.parse_args()
     sys.exit(asyncio.run(main_async(args)))
 

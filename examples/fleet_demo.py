@@ -34,7 +34,8 @@ from distributed_inference_engine_tpu.config import (  # noqa: E402
 )
 
 
-async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> None:
+async def run(n_workers: int, n_requests: int, strategy: str, kill: bool,
+              trace_out: str = "") -> None:
     print(f"=== fleet demo: {n_workers} workers, {n_requests} requests, "
           f"strategy={strategy} ===")
     workers = []
@@ -123,6 +124,18 @@ async def run(n_workers: int, n_requests: int, strategy: str, kill: bool) -> Non
     for wid, s in stats["load_balancer"]["workers"].items():
         print(f"    {wid}: reqs={s['request_count']} errs={s['error_count']} "
               f"avg_latency={s['avg_latency_s'] * 1e3:.1f}ms healthy={s['healthy']}")
+    if trace_out:
+        # flight recorder: clock-sync the survivors, pull their event
+        # rings + step timelines, and merge with the coordinator's own
+        # request spans into one Perfetto-loadable trace
+        from distributed_inference_engine_tpu.obs import clocksync
+
+        trace = await coord.fleet_trace(label="fleet_demo")
+        clocksync.dump_trace(trace_out, trace)
+        tracks = sum(1 for e in trace["traceEvents"]
+                     if e.get("name") == "process_name")
+        print(f"  fleet trace -> {trace_out} ({tracks} process tracks, "
+              f"{len(trace['traceEvents'])} events)")
     await coord.stop()
     for w in workers[1 if kill else 0:]:
         await w.stop()
@@ -138,9 +151,11 @@ def main() -> None:
                              "least_latency"])
     ap.add_argument("--no-kill", action="store_true",
                     help="skip the mid-run worker kill")
+    ap.add_argument("--trace-out", default="",
+                    help="dump a merged Perfetto fleet trace to this path")
     args = ap.parse_args()
     asyncio.run(run(args.workers, args.requests, args.strategy,
-                    kill=not args.no_kill))
+                    kill=not args.no_kill, trace_out=args.trace_out))
 
 
 if __name__ == "__main__":

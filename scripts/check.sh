@@ -127,6 +127,20 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+# --- stage 2g2: fast flight-recorder leg ------------------------------
+# fleet flight recorder (-m slo): typed event rings (wrap mid-capture,
+# canonical sequences), clock-sync merged-trace monotonicity with
+# mixed-sign offsets, SLO burn-rate engine windows + ledger
+# determinism, post-mortem bundle round-trip.
+echo "== flight recorder (-m 'slo and not slow') =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'slo and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+if [ "$rc" -ne 0 ]; then
+    echo "check.sh: flight recorder leg FAILED" >&2
+    exit "$rc"
+fi
+
 # --- stage 2: fast kernel-parity leg ----------------------------------
 # Pallas kernel tests (-m kernels) run standalone FIRST: a broken kernel
 # fails here in seconds instead of minutes into the full tier-1 sweep.

@@ -36,6 +36,13 @@ COLLECTORS_PY = f"{PKG}/obs/collectors.py"
 # a docs catalog row: | `family_name` | kind | labels | help |
 _ROW_RE = re.compile(
     r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)`\s*\|\s*(counter|gauge|histogram)\s*\|")
+# a docs EVENT catalog row: | `subsystem.event` | emitter | description |
+# — scanned only inside tables under the `| event | emitter | ...`
+# header, so dotted names elsewhere (the trace phase glossary) and the
+# dot-free metric rows can never collide with event rows
+_EVENT_HEADER_RE = re.compile(r"^\|\s*event\s*\|\s*emitter\s*\|")
+_EVENT_ROW_RE = re.compile(
+    r"^\|\s*`([a-z_][a-z0-9_]*\.[a-z0-9_.]+)`\s*\|")
 # knob references in prose: `EngineConfig.prefill_chunk` etc.
 _KNOB_REF_RE = re.compile(
     r"`(EngineConfig|BatcherConfig|CacheConfig|HealthConfig|ServerConfig|"
@@ -141,6 +148,99 @@ class DriftMetricsDocs(Rule):
         if not os.path.exists(os.path.join(project.root, COLLECTORS_PY)):
             return ()
         return check_metrics_drift(project.root)
+
+
+# -------------------------------------------------------------- events
+
+EVENTS_PY = f"{PKG}/obs/events.py"
+
+
+def load_events(root: str) -> Optional[Dict[str, str]]:
+    """Import obs.events.EVENTS (jax-free by contract) from ``root``,
+    under the same per-root alias scheme as ``load_catalog``."""
+    pkg_dir = os.path.join(root, PKG)
+    if not os.path.isfile(os.path.join(pkg_dir, "obs", "events.py")):
+        return None
+    alias = "_graftlint_catalog_%08x" % (
+        binascii.crc32(os.path.abspath(root).encode()) & 0xFFFFFFFF)
+    try:
+        mod = sys.modules.get(alias + ".obs.events")
+        if mod is None:
+            for name, path in ((alias, pkg_dir),
+                               (alias + ".obs", os.path.join(pkg_dir, "obs"))):
+                stub = types.ModuleType(name)
+                stub.__path__ = [path]
+                sys.modules.setdefault(name, stub)
+            importlib.invalidate_caches()
+            mod = importlib.import_module(alias + ".obs.events")
+        return dict(mod.EVENTS)
+    except Exception:
+        return None
+
+
+def check_events_drift(root: str) -> List[Finding]:
+    """Two-way event-catalog↔docs diff: every ``obs.events.EVENTS`` type
+    must have a docs event-table row, and every documented event type
+    must exist in the catalog (``EventLog.emit`` rejects unknown types,
+    so a stale row documents an event that can never fire)."""
+    out: List[Finding] = []
+
+    def mk(path: str, line: int, msg: str, key: str) -> Finding:
+        return Finding(rule="drift-events-docs", path=path, line=line,
+                       message=msg, key=key)
+
+    doc_path = os.path.join(root, OBS_DOC)
+    if not os.path.exists(doc_path):
+        return [mk(OBS_DOC, 1, f"{OBS_DOC} missing", "missing-doc")]
+    events = load_events(root)
+    if events is None:
+        return [mk(EVENTS_PY, 1,
+                   "cannot import obs.events.EVENTS", "no-events")]
+    with open(doc_path, encoding="utf-8") as f:
+        doc_text = f.read()
+    doc: Set[str] = set()
+    in_table = False
+    for line in doc_text.splitlines():
+        if _EVENT_HEADER_RE.match(line):
+            in_table = True
+            continue
+        if in_table and not line.startswith("|"):
+            in_table = False
+        if not in_table:
+            continue
+        m = _EVENT_ROW_RE.match(line)
+        if m:
+            doc.add(m.group(1))
+    ev_text = ""
+    ev_path = os.path.join(root, EVENTS_PY)
+    if os.path.exists(ev_path):
+        with open(ev_path, encoding="utf-8") as f:
+            ev_text = f.read()
+    for name in sorted(set(events) - doc):
+        out.append(mk(EVENTS_PY, _find_line(ev_text, f'"{name}"'),
+                      f"event type {name} is in the catalog but "
+                      f"undocumented in {OBS_DOC}", name))
+    for name in sorted(doc - set(events)):
+        out.append(mk(OBS_DOC, _find_line(doc_text, f"`{name}`"),
+                      f"event type {name} is documented but absent from "
+                      f"obs.events.EVENTS (stale row — emit would raise)",
+                      name))
+    return out
+
+
+@register
+class DriftEventsDocs(Rule):
+    id = "drift-events-docs"
+    family = "drift"
+    severity = "error"
+    doc = ("docs/observability.md event-catalog table and obs/events."
+           "EVENTS must agree both ways (typed emit makes a stale row "
+           "an event that can never fire)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        if not os.path.exists(os.path.join(project.root, EVENTS_PY)):
+            return ()
+        return check_events_drift(project.root)
 
 
 # --------------------------------------------------------------- knobs

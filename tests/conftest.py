@@ -97,6 +97,11 @@ def pytest_configure(config):
         "LRU eviction, background stage never blocks dispatch, probe-gated "
         "hot swap, model-qualified affinity/KV isolation, respawn reloads "
         "the resident set; fast leg: pytest -m 'multimodel and not slow')")
+    config.addinivalue_line(
+        "markers", "slo: fleet flight-recorder tests (typed event rings, "
+        "clock-sync trace merge, SLO burn-rate engine, post-mortem "
+        "bundles, same-seed determinism; fast leg: pytest -m 'slo and "
+        "not slow')")
 
 
 def pytest_pyfunc_call(pyfuncitem):

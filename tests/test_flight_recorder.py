@@ -1,0 +1,402 @@
+"""Fleet flight-recorder tests (-m slo): typed event rings (wrap
+mid-capture, canonical sequences), clock-sync merged-trace monotonicity
+under mixed-sign offsets, SLO burn-rate engine windows + ledger
+determinism, post-mortem bundle round-trip, and one live-fleet
+integration pass (events verb -> fleet trace -> bundle with the dead
+worker's ring preserved).
+
+The unit tests drive obs/{events,clocksync,slo,postmortem} directly with
+synthetic clocks and tracks — no RPC plumbing — which is exactly the
+testability contract those modules advertise. The integration test
+reuses the test_chaos fleet harness (fake continuous engines, crc32
+token chain) so event content is seed-deterministic.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from distributed_inference_engine_tpu.api.coordinator import (
+    Coordinator,
+    CoordinatorConfig,
+)
+from distributed_inference_engine_tpu.cluster.worker import WorkerServer
+from distributed_inference_engine_tpu.config import ModelConfig, ServerConfig
+from distributed_inference_engine_tpu.obs import clocksync
+from distributed_inference_engine_tpu.obs import postmortem as pm
+from distributed_inference_engine_tpu.obs.events import (
+    EVENTS,
+    EventLog,
+    canonical_from_snapshot,
+)
+from distributed_inference_engine_tpu.obs.slo import (
+    BurnObjective,
+    BurnRateEngine,
+    violations_from_buckets,
+)
+
+pytestmark = pytest.mark.slo
+
+
+# ------------------------------------------------------------ event rings
+
+def test_emit_unknown_type_raises():
+    log = EventLog("p")
+    with pytest.raises(ValueError):
+        log.emit("totally.fake_event", x=1)
+    assert len(log) == 0, "a rejected emit must not land"
+
+
+def test_event_catalog_shape():
+    assert EVENTS, "catalog must be non-empty"
+    for name, help_text in EVENTS.items():
+        assert "." in name and name == name.lower()
+        assert help_text.strip(), f"{name} needs a help string"
+
+
+def test_ring_wrap_mid_capture():
+    """Overflowing the ring drops the OLDEST events, counts the drops,
+    and keeps ``seq`` global — so a wrap is visible as a gap at the
+    front of the snapshot rather than silent truncation."""
+    log = EventLog("p", capacity=4)
+    for i in range(10):
+        log.emit("admission.accept", request_id=f"r{i}")
+    snap = log.snapshot()
+    assert snap["seq"] == 10
+    assert snap["dropped"] == 6
+    assert len(snap["events"]) == 4
+    seqs = [e["seq"] for e in snap["events"]]
+    assert seqs == [6, 7, 8, 9], "gap 0..5 visible at the front"
+    stats = log.get_stats()
+    assert stats["events_emitted"] == 10
+    assert stats["events_dropped"] == 6
+    assert stats["events_buffered"] == 4
+
+
+def test_canonical_sequence_ignores_timestamps():
+    a, b = EventLog("a"), EventLog("b")
+    for log in (a, b):
+        log.emit("drain.begin", worker_id="w0")
+        time.sleep(0.002)  # force differing stamps between the two logs
+        log.emit("fabric.export", model="m", pages=3)
+        log.emit("admission.reject", request_id="r1", reason="inbox_full")
+    assert a.canonical_sequence() == b.canonical_sequence()
+    # snapshot round trip (the RPC / bundle path) preserves the sequence
+    assert canonical_from_snapshot(a.snapshot()) == a.canonical_sequence()
+    # ...and the raw records DO differ in their timestamps
+    ta = [e["t_mono"] for e in a.events()]
+    tb = [e["t_mono"] for e in b.events()]
+    assert ta != tb
+
+
+def test_canonical_sequence_nested_args_hashable():
+    log = EventLog("p")
+    log.emit("model.stage", model="m", detail={"z": [1, 2], "a": "x"})
+    ((etype, args),) = log.canonical_sequence()
+    assert etype == "model.stage"
+    assert hash(args) is not None, "canonical form must be hashable"
+
+
+# -------------------------------------------------------------- clock sync
+
+async def test_estimate_offset_min_rtt_sample_wins():
+    """The estimate must track a large synthetic remote offset to within
+    half the best round trip, and the jitter filter must prefer the
+    fast sample."""
+    OFF = 1234.5
+
+    calls = {"n": 0}
+
+    async def ping():
+        calls["n"] += 1
+        # every other round trip is fat: the filter should ignore them
+        await asyncio.sleep(0.05 if calls["n"] % 2 == 0 else 0.0)
+        return {"mono": time.perf_counter() + OFF}
+
+    est = await clocksync.estimate_offset(ping, samples=6)
+    assert est["samples"] == 6.0
+    assert est["rtt_s"] < 0.05, "min-RTT sample must win"
+    assert abs(est["offset_s"] - OFF) <= max(est["rtt_s"], 0.02)
+
+
+async def test_estimate_offset_tolerates_missing_mono():
+    async def old_worker_ping():
+        return {"status": "ok"}          # pre-flight-recorder pong
+
+    est = await clocksync.estimate_offset(old_worker_ping, samples=3)
+    assert est == {"offset_s": 0.0, "rtt_s": 0.0, "samples": 0.0}
+
+
+def _per_track_ts(trace):
+    """Group emitted (non-metadata) events by (pid, tid) -> [ts...]."""
+    tracks = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        tracks.setdefault((ev["pid"], ev["tid"]), []).append(ev["ts"])
+    return tracks
+
+
+def test_merge_mixed_sign_offsets_per_track_monotone():
+    """Tracks whose clocks run AHEAD (+offset) and BEHIND (−offset) of
+    the coordinator must both come out per-track monotone, on one
+    shared non-negative epoch."""
+    def ring(base, n):
+        return [{"type": "admission.accept", "t_mono": base + 0.01 * i,
+                 "args": {"i": i}} for i in range(n)]
+
+    tracks = [
+        {"name": "coordinator", "offset_s": 0.0, "events": ring(100.0, 5),
+         "spans": [{"name": "request", "t": 100.001, "dur": 0.03,
+                    "args": {}}]},
+        {"name": "w0", "offset_s": +0.5, "events": ring(100.5, 5),
+         "steps": [{"name": "decode_step", "t": 100.51, "dur": 0.002,
+                    "args": {"step": 1}}]},
+        {"name": "w1", "offset_s": -0.5, "events": ring(99.5, 5)},
+    ]
+    trace = clocksync.merge_fleet_trace(tracks, label="mixed")
+    assert trace["metadata"]["tracks"] == 3
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"coordinator", "w0", "w1"}
+    per = _per_track_ts(trace)
+    assert per, "merged trace must contain emitted events"
+    for key, stamps in per.items():
+        assert stamps == sorted(stamps), f"track {key} not monotone"
+        assert all(ts >= 0.0 for ts in stamps), "epoch must be global min"
+    # the corrected w0/w1 rings line up with the coordinator's:
+    # all three started at corrected t=100.0 -> identical first stamps
+    firsts = {k: v[0] for k, v in per.items()
+              if k[1] == clocksync.TID_EVENTS}
+    assert len(set(round(t, 3) for t in firsts.values())) == 1
+
+
+def test_merge_zero_event_ring_and_dump(tmp_path):
+    """An empty fleet (or a worker with an empty ring) still merges to a
+    valid, loadable trace — metadata-only, zero events."""
+    trace = clocksync.merge_fleet_trace(
+        [{"name": "w0", "offset_s": 0.2}], label="empty")
+    assert trace["metadata"]["events"] == 0
+    assert all(e["ph"] == "M" for e in trace["traceEvents"])
+
+    path = str(tmp_path / "trace.json")
+    clocksync.dump_trace(path, trace)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded == trace
+
+
+def test_spans_from_trace_marks():
+    t0 = time.monotonic()
+    marks = {"received": t0, "routed": t0 + 0.01, "dispatched": t0 + 0.02,
+             "merged": t0 + 0.05, "responded": t0 + 0.06}
+    spans = clocksync.spans_from_trace_marks(marks, request_id="r1")
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"request", "admit", "route", "dispatch"}
+    assert by_name["request"]["args"]["request_id"] == "r1"
+    assert abs(by_name["request"]["dur"] - 0.06) < 1e-6
+    assert all(s["dur"] >= 0.0 for s in spans)
+    assert clocksync.spans_from_trace_marks({}) == []
+
+
+# ---------------------------------------------------------- burn-rate SLO
+
+def test_violations_from_buckets_snaps_to_grid():
+    buckets = {"0.1": 5.0, "0.5": 8.0, "+Inf": 10.0}
+    assert violations_from_buckets(buckets, 10.0, 0.5) == 2.0
+    # off-grid target snaps UP to the covering bound (conservative)
+    assert violations_from_buckets(buckets, 10.0, 0.2) == 2.0
+    assert violations_from_buckets(buckets, 10.0, 0.05) == 5.0
+    assert violations_from_buckets(buckets, 0.0, 0.5) == 0.0
+    assert violations_from_buckets({}, 10.0, 0.5) == 0.0
+
+
+def test_burn_engine_requires_both_windows():
+    """A fast-window spike alone must NOT engage the breach; only when
+    the slow window confirms does the ledger record burn_on, and a
+    clean fast window clears it."""
+    eng = BurnRateEngine([BurnObjective("ttft", goal=0.9)],
+                         fast_ticks=1, slow_ticks=4, threshold=3.0)
+    for _ in range(3):
+        assert eng.observe({"ttft": (10.0, 0.0)}) == []
+    # tick 4: fast burn = (10/10)/0.1 = 10 >= 3, slow = (10/40)/0.1
+    # = 2.5 < 3 -> fast alone is not enough
+    assert eng.observe({"ttft": (10.0, 10.0)}) == []
+    assert not eng.breached()
+    # tick 5: slow = (20/40)/0.1 = 5 >= 3 -> breach engages
+    (t_on,) = eng.observe({"ttft": (10.0, 10.0)})
+    assert t_on == {"objective": "ttft", "event": "burn_on"}
+    assert eng.breached() and eng.breached_objectives() == ["ttft"]
+    # a clean tick empties the 1-tick fast window -> breach clears
+    (t_off,) = eng.observe({"ttft": (10.0, 0.0)})
+    assert t_off == {"objective": "ttft", "event": "burn_off"}
+    assert not eng.breached()
+    assert eng.ledger() == [t_on, t_off]
+
+
+def test_burn_engine_clamps_and_empty_ticks():
+    eng = BurnRateEngine([BurnObjective("ok", goal=0.5)],
+                         fast_ticks=2, slow_ticks=2, threshold=1.0)
+    # bad > total must clamp to total (rate caps at 1.0, never above)
+    eng.observe({"ok": (4.0, 9.0)})
+    assert eng.burn_rate("ok", fast=True) == pytest.approx(2.0)
+    assert eng.breached()
+    # missing objective = empty tick; windows still advance, so the
+    # breach ages out as the bad tick scrolls off the 2-tick rings
+    eng.observe({})
+    eng.observe({})
+    assert eng.burn_rate("ok", fast=True) == 0.0
+    assert not eng.breached()
+    assert [e["event"] for e in eng.ledger()] == ["burn_on", "burn_off"]
+
+
+def test_burn_ledger_deterministic_and_timestamp_free():
+    feed = [(10.0, 0.0)] * 3 + [(10.0, 10.0)] * 4 + [(10.0, 0.0)] * 5
+
+    def run():
+        eng = BurnRateEngine([BurnObjective("ttft", goal=0.9)],
+                             fast_ticks=2, slow_ticks=6, threshold=1.0)
+        for total, bad in feed:
+            eng.observe({"ttft": (total, bad)})
+        return eng
+
+    a, b = run(), run()
+    assert a.ledger() == b.ledger() and a.ledger()
+    for entry in a.ledger():
+        assert set(entry) == {"objective", "event"}, \
+            "ledger entries must stay timestamp- and tick-free"
+    assert a.get_stats()["objectives"]["ttft"]["transitions"] == \
+        len(a.ledger())
+
+
+# -------------------------------------------------------------- post-mortem
+
+def _ring_snap(proc, n=2):
+    log = EventLog(proc)
+    for i in range(n):
+        log.emit("admission.accept", request_id=f"{proc}-r{i}")
+    return log.snapshot()
+
+
+def test_bundle_round_trip(tmp_path):
+    trace = clocksync.merge_fleet_trace(
+        [{"name": "coordinator", "offset_s": 0.0,
+          "events": [{"type": "drain.begin", "t_mono": 1.0,
+                      "args": {"worker_id": "w0"}}]}])
+    bundle = pm.write_bundle(
+        str(tmp_path), "chaos_hard_kill",
+        trace=trace,
+        metrics_text="# TYPE up gauge\nup 1\n",
+        event_rings={"coordinator": _ring_snap("coordinator")},
+        dead_rings={"w1": _ring_snap("w1", n=3)},
+        fault_ledger=[("w1", "server", "generate", 0, "kill")],
+        dead_workers=("w1",),
+        extra={"seed": 42},
+    )
+    back = pm.read_bundle(bundle)
+    man = back["manifest"]
+    assert man["reason"] == "chaos_hard_kill"
+    assert man["dead_workers"] == ["w1"]
+    assert man["files"] == sorted(["trace.json", "metrics.prom",
+                                   "rings.json", "dead_rings.json",
+                                   "faults.json"])
+    assert man["counts"]["faults"] == 1
+    assert man["extra"]["seed"] == 42
+    assert back["trace"]["metadata"]["events"] == 1
+    assert back["metrics"].startswith("# TYPE up")
+    # the dead worker's LAST-KNOWN ring survives, canonical-comparable
+    assert canonical_from_snapshot(back["dead_rings"]["w1"]) == \
+        canonical_from_snapshot(_ring_snap("w1", n=3))
+    assert back["faults"] == [["w1", "server", "generate", 0, "kill"]]
+    assert pm.list_bundles(str(tmp_path)) == [bundle]
+
+
+def test_bundle_writes_only_provided_payloads(tmp_path):
+    bundle = pm.write_bundle(str(tmp_path), "crashloop_open")
+    assert sorted(os.listdir(bundle)) == ["manifest.json"]
+    back = pm.read_bundle(bundle)
+    assert back["manifest"]["files"] == []
+    assert back["manifest"]["counts"] == {
+        "trace_events": 0, "rings": 0, "dead_rings": 0, "faults": 0}
+
+
+def test_bundle_name_collision_gets_counter(tmp_path):
+    a = pm.write_bundle(str(tmp_path), "same reason!")
+    b = pm.write_bundle(str(tmp_path), "same reason!")
+    assert a != b
+    assert os.path.basename(b).startswith(os.path.basename(a))
+    assert len(pm.list_bundles(str(tmp_path))) == 2
+    assert pm.list_bundles(str(tmp_path / "nope")) == []
+
+
+# ------------------------------------------------- live-fleet integration
+
+async def _start_fleet(n_workers):
+    coord = Coordinator(CoordinatorConfig(retry_seed=7,
+                                          retry_backoff_base_s=0.01))
+    await coord.start()
+    cfg = ModelConfig(name="m", architecture="fake",
+                      metadata={"continuous": 1, "max_slots": 4})
+    workers = {}
+    for i in range(n_workers):
+        w = WorkerServer(ServerConfig(host="127.0.0.1", port=0,
+                                      worker_id=f"w{i}"))
+        host, port = await w.start()
+        workers[f"w{i}"] = w
+        coord.add_worker(f"w{i}", host, port)
+    await coord.deploy_model(cfg)
+    return coord, workers
+
+
+async def test_fleet_events_trace_and_postmortem(tmp_path):
+    """End to end on a live 2-worker fleet: requests emit ring events,
+    the events verb collects them, the merged trace carries one track
+    per process with monotone corrected stamps, and a post-mortem after
+    a hard kill preserves the dead worker's last-known ring."""
+    coord, workers = await _start_fleet(2)
+    try:
+        for i in range(4):
+            r = await coord.submit("m", prompt=[10 + i, 2],
+                                   max_new_tokens=3)
+            assert r["tokens"], "fake engine must produce tokens"
+
+        await coord.estimate_offsets()
+        rings = await coord.collect_events()
+        assert set(rings) == {"w0", "w1"}
+        accepted = [
+            e for snap in rings.values()
+            for e in snap["ring"]["events"]
+            if e["type"] == "admission.accept"]
+        assert len(accepted) == 4, "every admit must land in some ring"
+
+        trace = await coord.fleet_trace(label="itest")
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert {"coordinator", "w0", "w1"} <= names
+        for key, stamps in _per_track_ts(trace).items():
+            assert stamps == sorted(stamps), f"track {key} not monotone"
+
+        # hard-kill w1 AFTER collection: its cached ring is now the only
+        # copy, which the bundle must preserve under dead_rings
+        await workers["w1"].stop()
+        bundle = await coord.write_postmortem(
+            "itest_kill", dead_workers=("w1",), dir_path=str(tmp_path))
+        assert bundle is not None
+        back = pm.read_bundle(bundle)
+        assert "w1" in back["manifest"]["dead_workers"]
+        assert "w1" in back["dead_rings"]
+        assert canonical_from_snapshot(back["dead_rings"]["w1"]["ring"]) \
+            == canonical_from_snapshot(rings["w1"]["ring"])
+        # the dump itself is on the coordinator's ring
+        assert coord.events.canonical_sequence()[-1][0] == \
+            "postmortem.bundle"
+    finally:
+        await coord.stop()
+        for w in workers.values():
+            try:
+                await w.stop()
+            except Exception:
+                pass

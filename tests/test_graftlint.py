@@ -19,7 +19,7 @@ from scripts.graftlint.core import (  # noqa: E402
     Baseline, Finding, build_project, run_rules, suppress, unsuppressed,
 )
 from scripts.graftlint.drift_rules import (  # noqa: E402
-    check_knob_drift, check_metrics_drift,
+    check_events_drift, check_knob_drift, check_metrics_drift,
 )
 
 pytestmark = pytest.mark.lint
@@ -566,6 +566,51 @@ def test_metrics_drift_clean(tmp_path):
     # load_catalog imports under a per-root alias, so this works even
     # with the real repo's package already imported by earlier tests
     assert check_metrics_drift(root) == []
+
+
+EVENTS_BODY = 'EVENTS = {"drain.begin": "h", "drain.done": "h"}\n'
+
+EVENT_TABLE = ("| event | emitter | meaning |\n"
+               "| --- | --- | --- |\n"
+               "| `drain.begin` | worker |  |\n"
+               "| `drain.done` | worker |  |\n")
+
+
+def _mini_events_repo(tmp_path, events_body, doc_text):
+    pkg = tmp_path / "distributed_inference_engine_tpu" / "obs"
+    pkg.mkdir(parents=True)
+    (pkg.parent / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "events.py").write_text(events_body)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(doc_text)
+    return str(tmp_path)
+
+
+def test_events_drift_clean(tmp_path):
+    root = _mini_events_repo(tmp_path, EVENTS_BODY, EVENT_TABLE)
+    assert check_events_drift(root) == []
+
+
+def test_events_drift_detects_both_directions(tmp_path):
+    doc = ("| event | emitter | meaning |\n| --- | --- | --- |\n"
+           "| `drain.begin` | worker |  |\n"
+           "| `ghost.event` | nobody |  |\n")
+    root = _mini_events_repo(tmp_path, EVENTS_BODY, doc)
+    keys = {f.key for f in check_events_drift(root)}
+    assert keys == {"drain.done",      # in catalog, undocumented
+                    "ghost.event"}     # documented, emit would raise
+
+
+def test_events_drift_ignores_rows_outside_event_table(tmp_path):
+    # dotted code spans in OTHER tables (e.g. the trace-phase glossary)
+    # must not be mistaken for event-catalog rows
+    doc = ("| phase | meaning |\n| --- | --- |\n"
+           "| `worker.received` | glossary row, not an event |\n\n"
+           + EVENT_TABLE)
+    root = _mini_events_repo(tmp_path, EVENTS_BODY, doc)
+    assert check_events_drift(root) == []
 
 
 def test_knob_drift_stale_field_and_phantom_bench_var(tmp_path):
